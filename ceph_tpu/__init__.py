@@ -1,0 +1,25 @@
+"""ceph_tpu — a TPU-native storage-compute framework.
+
+A from-scratch rebuild of the compute plane of Ceph (reference:
+yangly0815/ceph @ Pacific, mounted read-only at /root/reference) designed
+TPU-first in JAX/XLA/Pallas:
+
+- ``ceph_tpu.gf``     — GF(2^w) arithmetic oracle (numpy) + table generation.
+- ``ceph_tpu.ec``     — erasure-code framework: profiles, plugin registry,
+  Reed-Solomon (jerasure/isa-compatible semantics), LRC, SHEC, CLAY.
+- ``ceph_tpu.ops``    — TPU kernels: GF(2^8) Reed-Solomon as MXU bit-matmul
+  and Pallas kernels; batched CRUSH placement kernels.
+- ``ceph_tpu.crush``  — CRUSH placement: rjenkins hash, straw2, rule engine,
+  map builder/compiler, tester (crushtool --test equivalent).
+- ``ceph_tpu.osd``    — OSDMap model and batched PG->OSD mapping pipeline.
+- ``ceph_tpu.parallel`` — device-mesh sharding of stripe/PG batches.
+- ``ceph_tpu.tools``  — CLI benchmarks mirroring the reference harnesses
+  (ceph_erasure_code_benchmark, crushtool --test, osdmaptool).
+
+Byte-exactness contract: outputs must match the reference C semantics
+(src/erasure-code/*, src/crush/mapper.c) chunk-for-chunk; the numpy oracle
+in ``gf``/``crush`` is the executable spec, the TPU kernels are validated
+against it, and a corpus harness (tools/non_regression.py) pins regressions.
+"""
+
+__version__ = "0.1.0"

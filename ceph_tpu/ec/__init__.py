@@ -1,0 +1,30 @@
+"""Erasure-code framework: profiles, plugin registry, code families.
+
+Mirrors the reference's plugin architecture (SURVEY.md §2.1) with the same
+split of responsibilities:
+
+- ``interface``  — ``ErasureCode`` base class: chunk sizing, padding,
+  chunk remapping, greedy minimum_to_decode (ErasureCodeInterface.h:170,
+  ErasureCode.cc semantics).
+- ``registry``   — name → plugin factory (ErasureCodePlugin.cc:86), the
+  insertion point where TPU-backed plugins register.
+- ``jerasure``   — reed_sol_van / reed_sol_r6_op / cauchy_* technique
+  family (jerasure-compatible semantics, GF math from ceph_tpu.gf).
+- ``isa``        — isa-l compatible RS/Cauchy (w=8) with decode-table cache.
+- ``lrc/shec/clay`` — layered codes composing over the base families.
+
+Plugins accept a ``backend`` profile key: ``numpy`` (oracle, default off
+device) or ``jax`` (TPU bit-matmul kernels from ceph_tpu.ops).
+"""
+
+from . import jerasure as _jerasure  # noqa: F401  (self-registration)
+from . import isa as _isa  # noqa: F401
+from .interface import ErasureCode, ErasureCodeProfile
+from .registry import ErasureCodePluginRegistry, instance as registry_instance
+
+__all__ = [
+    "ErasureCode",
+    "ErasureCodeProfile",
+    "ErasureCodePluginRegistry",
+    "registry_instance",
+]

@@ -1,0 +1,75 @@
+"""Compute backends for erasure-code region math.
+
+The reference dispatches its GF region kernels to CPU SIMD libraries
+(gf-complete / isa-l asm); here the same seam dispatches to either the
+numpy oracle or the TPU kernels in ``ceph_tpu.ops`` (registered lazily on
+first use of ``backend=jax``).  Both implement:
+
+- ``matrix_regions(matrix, regions, w)``      — GF(2^w) matrix x chunk
+  regions (the jerasure_matrix_encode / ec_encode_data contract).
+- ``bitmatrix_regions(bm, regions, w, packetsize)`` — GF(2) bitmatrix over
+  packet-interleaved regions (the jerasure_bitmatrix_dotprod contract:
+  each chunk is blocks of w packets of ``packetsize`` bytes; output packet
+  (i) of a block = XOR of input packets (j) where bm[i, j] == 1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..gf import matrix_vector_mul_region
+
+
+class NumpyBackend:
+    name = "numpy"
+
+    def matrix_regions(
+        self, matrix: np.ndarray, regions: np.ndarray, w: int
+    ) -> np.ndarray:
+        return matrix_vector_mul_region(matrix, regions, w)
+
+    def bitmatrix_regions(
+        self,
+        bm: np.ndarray,
+        regions: np.ndarray,
+        w: int,
+        packetsize: int,
+    ) -> np.ndarray:
+        n, size = regions.shape
+        out_rows = bm.shape[0] // w
+        block = w * packetsize
+        assert size % block == 0, (size, block)
+        nblocks = size // block
+        # (n, nblocks, w, p) -> (nblocks, n*w, p)
+        planes = (
+            regions.reshape(n, nblocks, w, packetsize)
+            .transpose(1, 0, 2, 3)
+            .reshape(nblocks, n * w, packetsize)
+        )
+        bits = np.unpackbits(planes, axis=2)
+        out_bits = (
+            bm.astype(np.int32) @ bits.astype(np.int32)
+        ) & 1
+        out = np.packbits(out_bits.astype(np.uint8), axis=2)
+        return (
+            out.reshape(nblocks, out_rows, w, packetsize)
+            .transpose(1, 0, 2, 3)
+            .reshape(out_rows, size)
+        )
+
+
+_backends: dict[str, object] = {"numpy": NumpyBackend()}
+
+
+def register_backend(name: str, backend) -> None:
+    _backends[name] = backend
+
+
+def get_backend(name: str):
+    if name == "jax" and "jax" not in _backends:
+        from .. import ops  # self-registers the jax backend
+
+        assert "jax" in _backends
+    if name not in _backends:
+        raise ValueError(f"unknown EC backend {name!r} (have {sorted(_backends)})")
+    return _backends[name]

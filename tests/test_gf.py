@@ -1,0 +1,182 @@
+"""GF(2^w) arithmetic oracle tests — algebraic properties plus known
+values pinned from the field definitions (poly 0x11D/0x1100B/0x400007)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu import gf
+
+
+@pytest.mark.parametrize("w", [8, 16, 32])
+def test_mul_identity_zero(w):
+    for a in [1, 2, 3, 0x53, (1 << w) - 1]:
+        assert gf.gf_mul_scalar(a, 1, w) == a
+        assert gf.gf_mul_scalar(a, 0, w) == 0
+        assert gf.gf_mul_scalar(0, a, w) == 0
+
+
+def test_known_values_w8():
+    # 0x80 * 2 = 0x100 ^ 0x11D = 0x1D
+    assert gf.gf_mul_scalar(0x80, 2, 8) == 0x1D
+    assert gf.gf_mul_scalar(2, 2, 8) == 4
+    # alpha is primitive: order 255
+    assert gf.gf_pow_scalar(2, 255, 8) == 1
+    assert gf.gf_pow_scalar(2, 51, 8) != 1
+
+
+def test_known_values_w16_w32():
+    # 0x8000 * 2 = 0x10000 ^ 0x1100B = 0x100B
+    assert gf.gf_mul_scalar(0x8000, 2, 16) == 0x100B
+    # 0x80000000 * 2 = 2^32 ^ (2^32 + 0x400007) = 0x400007
+    assert gf.gf_mul_scalar(0x80000000, 2, 32) == 0x400007
+
+
+@pytest.mark.parametrize("w", [8, 16, 32])
+def test_inverse(w):
+    rng = np.random.default_rng(0)
+    vals = [1, 2, 3] + [int(v) for v in rng.integers(1, 1 << w, size=8)]
+    for a in vals:
+        inv = gf.gf_inv(a, w)
+        assert gf.gf_mul_scalar(a, inv, w) == 1
+
+
+@pytest.mark.parametrize("w", [8, 16])
+def test_mul_commutative_associative_distributive(w):
+    rng = np.random.default_rng(1)
+    hi = 1 << w
+    a, b, c = (int(v) for v in rng.integers(0, hi, size=3))
+    assert gf.gf_mul_scalar(a, b, w) == gf.gf_mul_scalar(b, a, w)
+    assert gf.gf_mul_scalar(
+        a, gf.gf_mul_scalar(b, c, w), w
+    ) == gf.gf_mul_scalar(gf.gf_mul_scalar(a, b, w), c, w)
+    assert gf.gf_mul_scalar(a, b ^ c, w) == gf.gf_mul_scalar(
+        a, b, w
+    ) ^ gf.gf_mul_scalar(a, c, w)
+
+
+@pytest.mark.parametrize("w", [8, 16])
+def test_vectorized_matches_scalar(w):
+    rng = np.random.default_rng(2)
+    hi = 1 << w
+    a = rng.integers(0, hi, size=64)
+    b = rng.integers(0, hi, size=64)
+    vec = gf.gf_mul(a, b, w)
+    for i in range(64):
+        assert int(vec[i]) == gf.gf_mul_scalar(int(a[i]), int(b[i]), w)
+
+
+@pytest.mark.parametrize("w", [8, 16, 32])
+def test_region_mul_matches_scalar(w):
+    rng = np.random.default_rng(3)
+    nbytes = 64
+    region = rng.integers(0, 256, size=nbytes).astype(np.uint8)
+    c = int(rng.integers(1, min(1 << w, 1 << 16)))
+    out = gf.region_mul(region, c, w)
+    words_in = region.view(f"<u{w // 8}")
+    words_out = out.view(f"<u{w // 8}")
+    for i in range(len(words_in)):
+        assert int(words_out[i]) == gf.gf_mul_scalar(int(words_in[i]), c, w)
+
+
+@pytest.mark.parametrize("w", [8, 16])
+@pytest.mark.parametrize("k,m", [(4, 2), (8, 3), (10, 4)])
+def test_vandermonde_structure(w, k, m):
+    mat = gf.reed_sol_vandermonde_coding_matrix(k, m, w)
+    assert mat.shape == (m, k)
+    # jerasure invariants: first coding row all ones; first column all ones
+    assert (mat[0] == 1).all()
+    assert (mat[:, 0] == 1).all()
+    assert (mat > 0).all()
+
+
+@pytest.mark.parametrize("w", [8, 16])
+@pytest.mark.parametrize(
+    "maker",
+    [
+        lambda k, m, w: gf.reed_sol_vandermonde_coding_matrix(k, m, w),
+        lambda k, m, w: gf.cauchy_original_matrix(k, m, w),
+        lambda k, m, w: gf.cauchy_good_matrix(k, m, w),
+    ],
+)
+def test_matrices_are_mds(w, maker):
+    """Every k×k submatrix of [I; C] must be invertible (MDS property) —
+    checked exhaustively for k=4, m=2."""
+    import itertools
+
+    k, m = 4, 2
+    cm = maker(k, m, w)
+    for erased in itertools.combinations(range(k + m), m):
+        rows, survivors = gf.make_decoding_matrix(cm, list(erased), k, w)
+        assert rows.shape[1] == k
+
+
+def test_isa_matrices():
+    k, m = 8, 3
+    rs = gf.isa_rs_matrix(k, m)
+    assert (rs[0] == 1).all()  # gen=1 row
+    assert rs[1, 1] == 2 and rs[1, 2] == 4  # gen=2 row: powers of 2
+    cauchy = gf.isa_cauchy_matrix(k, m)
+    for j in range(k):
+        assert gf.gf_mul_scalar(int(cauchy[0, j]), 8 ^ j, 8) == 1
+
+
+def test_matrix_invert_roundtrip():
+    rng = np.random.default_rng(4)
+    for w in (8, 16):
+        for _ in range(5):
+            n = 5
+            while True:
+                mat = rng.integers(0, 1 << w, size=(n, n))
+                try:
+                    inv = gf.matrix_invert(mat, w)
+                    break
+                except np.linalg.LinAlgError:
+                    continue
+            prod = gf.matrix_multiply(inv, mat, w)
+            assert (prod == np.eye(n, dtype=np.int64)).all()
+
+
+@pytest.mark.parametrize("w", [8, 16, 32])
+def test_encode_decode_region_roundtrip(w):
+    """Encode k data regions, erase m chunks, decode back — byte exact."""
+    import itertools
+
+    rng = np.random.default_rng(5)
+    k, m = 4, 2
+    nbytes = 128
+    cm = (
+        gf.reed_sol_vandermonde_coding_matrix(k, m, w)
+        if w != 32
+        else gf.reed_sol_vandermonde_coding_matrix(k, m, w)
+    )
+    data = rng.integers(0, 256, size=(k, nbytes)).astype(np.uint8)
+    coding = gf.matrix_vector_mul_region(cm, data, w)
+    chunks = np.concatenate([data, coding], axis=0)
+    for erased in itertools.combinations(range(k + m), m):
+        rows, survivors = gf.make_decoding_matrix(cm, list(erased), k, w)
+        surv = chunks[survivors]
+        data_erasures = sorted(e for e in erased if e < k)
+        rec = gf.matrix_vector_mul_region(rows, surv, w)
+        for idx, e in enumerate(data_erasures):
+            assert (rec[idx] == data[e]).all(), (erased, e)
+
+
+def test_bitmatrix_equals_gf_mul():
+    """Bitmatrix (m*w, k*w) applied to bit-decomposed words must equal GF
+    multiplication — the correctness basis of the TPU bit-matmul kernel."""
+    rng = np.random.default_rng(6)
+    w, k, m = 8, 4, 2
+    cm = gf.cauchy_good_matrix(k, m, w)
+    bm = gf.jerasure_bitmatrix(cm, w)
+    words = rng.integers(0, 256, size=k)
+    bits = np.zeros(k * w, dtype=np.uint8)
+    for j in range(k):
+        for l in range(w):
+            bits[j * w + l] = (int(words[j]) >> l) & 1
+    out_bits = (bm @ bits) % 2
+    for i in range(m):
+        expect = 0
+        for j in range(k):
+            expect ^= gf.gf_mul_scalar(int(cm[i, j]), int(words[j]), w)
+        got = sum(int(out_bits[i * w + l]) << l for l in range(w))
+        assert got == expect
