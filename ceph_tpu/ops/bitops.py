@@ -1,0 +1,77 @@
+"""Bit-plane (un)packing for GF(2^w) word regions, in jax.numpy.
+
+Layout contract (matches the jerasure bitmatrix convention consumed by
+``ceph_tpu.gf.jerasure_bitmatrix``): a byte region is a sequence of
+little-endian w-bit words; bit x of word j is indexed LSB-first, i.e.
+``bit(word, x) = (word >> x) & 1``; with little-endian bytes this means
+bit x lives in byte ``x // 8`` at in-byte position ``x % 8``.
+
+``unpack_word_bits`` turns (n, nbytes) uint8 regions into (n*w, nwords)
+0/1 planes, row ``j*w + x`` holding bit x of region j's words — exactly
+the column index space of a (R, n*w) bitmatrix.  ``pack_word_bits`` is
+the inverse.  Both are pure VPU element-wise code that XLA fuses.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_BIT_POS = np.left_shift(np.uint8(1), np.arange(8, dtype=np.uint8))
+
+
+def _bitpos():
+    return _BIT_POS
+
+
+def unpack_word_bits(regions: jnp.ndarray, w: int) -> jnp.ndarray:
+    """(n, nbytes) uint8 → (n*w, nwords) int8 bit planes (values 0/1)."""
+    n, nbytes = regions.shape
+    assert nbytes % (w // 8) == 0, (nbytes, w)
+    nwords = nbytes // (w // 8)
+    # byte-level LSB-first unpack: (n, nbytes, 8)
+    bits = (
+        jnp.right_shift(
+            regions[:, :, None], jnp.arange(8, dtype=jnp.uint8)[None, None, :]
+        )
+        & 1
+    )
+    # little-endian bytes: word bit index = 8*byte_in_word + bit_in_byte
+    bits = bits.reshape(n, nwords, w)
+    return bits.transpose(0, 2, 1).reshape(n * w, nwords).astype(jnp.int8)
+
+
+def pack_word_bits(bits: jnp.ndarray, w: int) -> jnp.ndarray:
+    """(m*w, nwords) 0/1 → (m, nwords * w//8) uint8 regions (inverse)."""
+    mw, nwords = bits.shape
+    assert mw % w == 0
+    m = mw // w
+    bits = bits.reshape(m, w, nwords).transpose(0, 2, 1)  # (m, nwords, w)
+    bits = bits.reshape(m, nwords, w // 8, 8).astype(jnp.uint8)
+    by = (bits * _bitpos()[None, None, None, :]).sum(
+        axis=-1, dtype=jnp.uint8
+    )
+    return by.reshape(m, nwords * (w // 8))
+
+
+def unpack_byte_bits(regions: jnp.ndarray) -> jnp.ndarray:
+    """(r, c) uint8 → (r, c*8) 0/1 int8, LSB-first per byte.
+
+    Order only needs to be self-consistent with ``pack_byte_bits`` —
+    used for XOR-of-packet-regions where bytes are opaque."""
+    r, c = regions.shape
+    bits = (
+        jnp.right_shift(
+            regions[:, :, None], jnp.arange(8, dtype=jnp.uint8)[None, None, :]
+        )
+        & 1
+    )
+    return bits.reshape(r, c * 8).astype(jnp.int8)
+
+
+def pack_byte_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """(r, c*8) 0/1 → (r, c) uint8 (inverse of unpack_byte_bits)."""
+    r, c8 = bits.shape
+    assert c8 % 8 == 0
+    bits = bits.reshape(r, c8 // 8, 8).astype(jnp.uint8)
+    return (bits * _bitpos()[None, None, :]).sum(axis=-1, dtype=jnp.uint8)

@@ -1,0 +1,63 @@
+"""The ``jax`` erasure-code backend: device dispatch of region math.
+
+Slots under every code family through the same seam the reference uses
+for gf-complete/isa-l (ceph_tpu.ec.backend); numpy in, numpy out, with
+jit-compiled mod-2 matmuls in between.  The first call for a given
+(shape, matrix-shape, w) pair compiles; later calls replay the cached
+executable — the analog of the reference's one-time ec_init_tables SIMD
+table expansion (src/erasure-code/isa/ErasureCodeIsa.cc:402).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ec.backend import register_backend
+from .gf_matmul import (
+    bitmatrix_packet_regions,
+    gf_matrix_regions,
+    gf_matrix_stripes,
+    matrix_to_device_bitmatrix,
+)
+
+
+class JaxBackend:
+    name = "jax"
+
+    def matrix_regions(
+        self, matrix: np.ndarray, regions: np.ndarray, w: int
+    ) -> np.ndarray:
+        bm = matrix_to_device_bitmatrix(matrix, w)
+        out = gf_matrix_regions(bm, jnp.asarray(regions), w=w)
+        return np.asarray(out)
+
+    def bitmatrix_regions(
+        self,
+        bm: np.ndarray,
+        regions: np.ndarray,
+        w: int,
+        packetsize: int,
+    ) -> np.ndarray:
+        out = bitmatrix_packet_regions(
+            jnp.asarray(bm, dtype=jnp.int8),
+            jnp.asarray(regions),
+            w=w,
+            packetsize=packetsize,
+        )
+        return np.asarray(out)
+
+    def matrix_stripes(
+        self, matrix: np.ndarray, stripes, w: int
+    ) -> np.ndarray:
+        """Batched (B, k, chunk) → (B, m, chunk); accepts device arrays."""
+        bm = matrix_to_device_bitmatrix(matrix, w)
+        return gf_matrix_stripes(bm, jnp.asarray(stripes), w=w)
+
+
+_backend = JaxBackend()
+register_backend("jax", _backend)
+
+
+def get_jax_backend() -> JaxBackend:
+    return _backend

@@ -1,0 +1,120 @@
+"""GF(2^w) region math as mod-2 matmuls (the MXU formulation).
+
+The reference computes ``coding[i] = Σ_j M[i,j] ⊗ data[j]`` with per-
+coefficient table-lookup region passes (jerasure_matrix_encode /
+ec_encode_data, SURVEY.md §3.1).  Multiplication by a constant in
+GF(2^w) is linear over GF(2), so the whole matrix lifts to a
+(m·w, k·w) bitmatrix B and the kernel is
+
+    bits_out = (B @ bits_in) & 1
+
+one int8 matmul with int32 accumulation — dense, static-shaped, and
+tiled straight onto the systolic array.  Decode is the same kernel with
+the inverted-survivor-submatrix rows (built host-side, tiny).
+
+Two bit layouts share the primitive:
+
+- word layout (matrix techniques, w ∈ {8,16,32}): bit x of each
+  little-endian w-bit word → ``gf_matrix_regions``.
+- packet layout (bitmatrix techniques: cauchy/liberation XOR schedules):
+  regions are blocks of w packets of ``packetsize`` bytes; B works on
+  whole packets, bytes are opaque → ``bitmatrix_packet_regions``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .bitops import (
+    pack_byte_bits,
+    pack_word_bits,
+    unpack_byte_bits,
+    unpack_word_bits,
+)
+
+
+def mod2_matmul(bm: jnp.ndarray, bits: jnp.ndarray) -> jnp.ndarray:
+    """(R, C) 0/1 @ (C, N) 0/1 → (R, N) 0/1 via int8 matmul, int32 acc."""
+    acc = jax.lax.dot_general(
+        bm.astype(jnp.int8),
+        bits,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (acc & 1).astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("w",))
+def gf_matrix_regions(
+    bm: jnp.ndarray, regions: jnp.ndarray, *, w: int
+) -> jnp.ndarray:
+    """Apply a GF(2^w) coding matrix, given as its (m·w, k·w) bitmatrix,
+    to (k, nbytes) uint8 regions → (m, nbytes) uint8."""
+    bits = unpack_word_bits(regions, w)
+    out = mod2_matmul(bm, bits)
+    return pack_word_bits(out, w)
+
+
+@functools.partial(jax.jit, static_argnames=("w", "packetsize"))
+def bitmatrix_packet_regions(
+    bm: jnp.ndarray, regions: jnp.ndarray, *, w: int, packetsize: int
+) -> jnp.ndarray:
+    """jerasure_bitmatrix_dotprod contract: each region is blocks of w
+    packets of ``packetsize`` bytes; output packet i of each block is the
+    XOR of input packets j where bm[i, j] == 1."""
+    n, size = regions.shape
+    out_rows = bm.shape[0] // w
+    block = w * packetsize
+    assert size % block == 0, (size, block)
+    nblocks = size // block
+    # (n, size) → packet planes (n*w, nblocks*packetsize): row j*w+p is
+    # packet p of region j, blocks laid out contiguously per row.
+    planes = (
+        regions.reshape(n, nblocks, w, packetsize)
+        .transpose(0, 2, 1, 3)
+        .reshape(n * w, nblocks * packetsize)
+    )
+    bits = unpack_byte_bits(planes)
+    out = pack_byte_bits(mod2_matmul(bm, bits))
+    return (
+        out.reshape(out_rows, w, nblocks, packetsize)
+        .transpose(0, 2, 1, 3)
+        .reshape(out_rows, size)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("w",))
+def gf_matrix_stripes(
+    bm: jnp.ndarray, stripes: jnp.ndarray, *, w: int
+) -> jnp.ndarray:
+    """Batched encode: (B, k, chunk_bytes) → (B, m, chunk_bytes).
+
+    The ECUtil::encode per-stripe loop (src/osd/ECUtil.cc:123-162) hoisted
+    into one device call: stripes fold into the matmul N dimension, so
+    arbitrarily many stripes ride a single kernel launch."""
+    b, k, chunk = stripes.shape
+    flat = stripes.transpose(1, 0, 2).reshape(k, b * chunk)
+    out = gf_matrix_regions(bm, flat, w=w)
+    m = out.shape[0]
+    return out.reshape(m, b, chunk).transpose(1, 0, 2)
+
+
+@functools.lru_cache(maxsize=512)
+def _bitmatrix_cache(key: bytes, shape: tuple, w: int) -> np.ndarray:
+    from .. import gf
+
+    mat = np.frombuffer(key, dtype=np.int64).reshape(shape)
+    return gf.jerasure_bitmatrix(mat, w)
+
+
+def matrix_to_device_bitmatrix(matrix: np.ndarray, w: int) -> jnp.ndarray:
+    """Host-side lift of a GF(2^w) matrix to its bitmatrix, cached by
+    value (the analog of ErasureCodeIsaTableCache: the expensive per-
+    erasure-signature preparation happens once per distinct matrix)."""
+    mat = np.ascontiguousarray(matrix, dtype=np.int64)
+    bm = _bitmatrix_cache(mat.tobytes(), mat.shape, w)
+    return jnp.asarray(bm, dtype=jnp.int8)
