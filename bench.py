@@ -1,0 +1,96 @@
+"""Round benchmark: EC encode throughput at the BASELINE.md headline config.
+
+Mirrors ``ceph_erasure_code_benchmark --workload encode --parameter k=8
+--parameter m=3`` with 1MB stripes (src/test/erasure-code/
+ceph_erasure_code_benchmark.cc:156-186): GB/s of *input* bytes encoded.
+
+The reference publishes no absolute numbers (BASELINE.md), so
+``vs_baseline`` is measured live: the same encode through the numpy
+region-math oracle on this host's CPU stands in for the
+jerasure/gf-complete table-lookup path, and the reported ratio is
+device GB/s / CPU GB/s.
+
+Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+K, M, W = 8, 3, 8
+OBJECT_SIZE = 1 << 20  # 1MB stripe
+CHUNK = OBJECT_SIZE // K
+
+
+def _log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def measure_device(matrix, batch: int, iters: int) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops.gf_matmul import (
+        gf_matrix_stripes,
+        matrix_to_device_bitmatrix,
+    )
+
+    bm = matrix_to_device_bitmatrix(matrix, W)
+    rng = np.random.default_rng(1)
+    stripes = jax.device_put(
+        rng.integers(0, 256, size=(batch, K, CHUNK), dtype=np.uint8)
+    )
+    gf_matrix_stripes(bm, stripes, w=W).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = gf_matrix_stripes(bm, stripes, w=W)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    total = batch * K * CHUNK * iters
+    _log(
+        f"device[{jax.devices()[0].platform}]: {total / dt / 2**30:.3f} GB/s "
+        f"({iters} iters x {batch} stripes x {OBJECT_SIZE >> 20}MB, {dt:.3f}s)"
+    )
+    return total / dt / 2**30
+
+
+def measure_cpu(matrix, iters: int) -> float:
+    from ceph_tpu.gf import matrix_vector_mul_region
+
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, size=(K, CHUNK), dtype=np.uint8)
+    matrix_vector_mul_region(matrix, data, W)  # warm table caches
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        matrix_vector_mul_region(matrix, data, W)
+    dt = time.perf_counter() - t0
+    total = K * CHUNK * iters
+    _log(f"cpu oracle: {total / dt / 2**30:.3f} GB/s ({iters} stripes, {dt:.3f}s)")
+    return total / dt / 2**30
+
+
+def main() -> None:
+    from ceph_tpu import gf
+
+    matrix = gf.reed_sol_vandermonde_coding_matrix(K, M, W)
+    gbs = measure_device(matrix, batch=32, iters=10)
+    cpu = measure_cpu(matrix, iters=8)
+    print(
+        json.dumps(
+            {
+                "metric": "ec_encode_k8m3_1M_GBps",
+                "value": round(gbs, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(gbs / cpu, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

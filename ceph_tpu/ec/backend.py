@@ -28,6 +28,17 @@ class NumpyBackend:
     ) -> np.ndarray:
         return matrix_vector_mul_region(matrix, regions, w)
 
+    def matrix_stripes(
+        self, matrix: np.ndarray, stripes: np.ndarray, w: int
+    ) -> np.ndarray:
+        """Batched (B, k, chunk) → (B, m, chunk): stripes fold into the
+        region byte dimension (same layout as the jax backend)."""
+        stripes = np.ascontiguousarray(stripes, dtype=np.uint8)
+        b, k, chunk = stripes.shape
+        flat = stripes.transpose(1, 0, 2).reshape(k, b * chunk)
+        out = matrix_vector_mul_region(matrix, flat, w)
+        return out.reshape(-1, b, chunk).transpose(1, 0, 2)
+
     def bitmatrix_regions(
         self,
         bm: np.ndarray,

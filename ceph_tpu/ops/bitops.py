@@ -20,10 +20,6 @@ import numpy as np
 _BIT_POS = np.left_shift(np.uint8(1), np.arange(8, dtype=np.uint8))
 
 
-def _bitpos():
-    return _BIT_POS
-
-
 def unpack_word_bits(regions: jnp.ndarray, w: int) -> jnp.ndarray:
     """(n, nbytes) uint8 → (n*w, nwords) int8 bit planes (values 0/1)."""
     n, nbytes = regions.shape
@@ -48,7 +44,7 @@ def pack_word_bits(bits: jnp.ndarray, w: int) -> jnp.ndarray:
     m = mw // w
     bits = bits.reshape(m, w, nwords).transpose(0, 2, 1)  # (m, nwords, w)
     bits = bits.reshape(m, nwords, w // 8, 8).astype(jnp.uint8)
-    by = (bits * _bitpos()[None, None, None, :]).sum(
+    by = (bits * _BIT_POS[None, None, None, :]).sum(
         axis=-1, dtype=jnp.uint8
     )
     return by.reshape(m, nwords * (w // 8))
@@ -74,4 +70,4 @@ def pack_byte_bits(bits: jnp.ndarray) -> jnp.ndarray:
     r, c8 = bits.shape
     assert c8 % 8 == 0
     bits = bits.reshape(r, c8 // 8, 8).astype(jnp.uint8)
-    return (bits * _bitpos()[None, None, :]).sum(axis=-1, dtype=jnp.uint8)
+    return (bits * _BIT_POS[None, None, :]).sum(axis=-1, dtype=jnp.uint8)

@@ -50,9 +50,12 @@ class JaxBackend:
     def matrix_stripes(
         self, matrix: np.ndarray, stripes, w: int
     ) -> np.ndarray:
-        """Batched (B, k, chunk) → (B, m, chunk); accepts device arrays."""
+        """Batched (B, k, chunk) → (B, m, chunk); numpy in, numpy out.
+
+        Device-array pipelines that want to keep results on-chip call
+        ``ops.gf_matmul.gf_matrix_stripes`` directly instead."""
         bm = matrix_to_device_bitmatrix(matrix, w)
-        return gf_matrix_stripes(bm, jnp.asarray(stripes), w=w)
+        return np.asarray(gf_matrix_stripes(bm, jnp.asarray(stripes), w=w))
 
 
 _backend = JaxBackend()

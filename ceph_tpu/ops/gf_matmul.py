@@ -104,17 +104,17 @@ def gf_matrix_stripes(
 
 
 @functools.lru_cache(maxsize=512)
-def _bitmatrix_cache(key: bytes, shape: tuple, w: int) -> np.ndarray:
+def _bitmatrix_cache(key: bytes, shape: tuple, w: int) -> jnp.ndarray:
     from .. import gf
 
     mat = np.frombuffer(key, dtype=np.int64).reshape(shape)
-    return gf.jerasure_bitmatrix(mat, w)
+    return jnp.asarray(gf.jerasure_bitmatrix(mat, w), dtype=jnp.int8)
 
 
 def matrix_to_device_bitmatrix(matrix: np.ndarray, w: int) -> jnp.ndarray:
-    """Host-side lift of a GF(2^w) matrix to its bitmatrix, cached by
-    value (the analog of ErasureCodeIsaTableCache: the expensive per-
-    erasure-signature preparation happens once per distinct matrix)."""
+    """Lift a GF(2^w) matrix to its device-resident bitmatrix, cached by
+    value — bitmatrix expansion AND host→device transfer happen once per
+    distinct matrix (the analog of ErasureCodeIsaTableCache's one-time
+    per-erasure-signature table preparation)."""
     mat = np.ascontiguousarray(matrix, dtype=np.int64)
-    bm = _bitmatrix_cache(mat.tobytes(), mat.shape, w)
-    return jnp.asarray(bm, dtype=jnp.int8)
+    return _bitmatrix_cache(mat.tobytes(), mat.shape, w)
