@@ -1,0 +1,50 @@
+"""CRUSH placement for the TPU-native framework.
+
+The reference's CRUSH core (src/crush/mapper.c, hash.c, builder.c) is a
+pure integer function of (map, rule, x, weights) — re-derived here in
+three tiers:
+
+- ``mapper`` / ``buckets`` — the exact-semantics CPU oracle (pure
+  Python): byte-for-byte the same placements as ``crush_do_rule``
+  (verified against the compiled reference C over all bucket
+  algorithms; see tests/test_crush.py).
+- ``builder`` — map construction (builder.c / CrushWrapper equivalent).
+- ``jaxmap`` (in progress) — the batched device kernel: the whole map
+  compiled to dense arrays, straw2 + rule interpretation vmapped over
+  PGs (the ParallelPGMapper replacement; SURVEY.md §2.3).
+"""
+
+from .builder import CrushMap
+from .hashing import crush_hash32, crush_hash32_2, crush_hash32_3
+from .ln import crush_ln
+from .mapper import CRUSH_ITEM_NONE, crush_do_rule
+from .types import (
+    CRUSH_BUCKET_LIST,
+    CRUSH_BUCKET_STRAW,
+    CRUSH_BUCKET_STRAW2,
+    CRUSH_BUCKET_TREE,
+    CRUSH_BUCKET_UNIFORM,
+    Bucket,
+    Rule,
+    RuleStep,
+    Tunables,
+)
+
+__all__ = [
+    "CRUSH_BUCKET_LIST",
+    "CRUSH_BUCKET_STRAW",
+    "CRUSH_BUCKET_STRAW2",
+    "CRUSH_BUCKET_TREE",
+    "CRUSH_BUCKET_UNIFORM",
+    "CRUSH_ITEM_NONE",
+    "Bucket",
+    "CrushMap",
+    "Rule",
+    "RuleStep",
+    "Tunables",
+    "crush_do_rule",
+    "crush_hash32",
+    "crush_hash32_2",
+    "crush_hash32_3",
+    "crush_ln",
+]

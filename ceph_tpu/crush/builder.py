@@ -1,0 +1,282 @@
+"""CrushMap construction — builder.c + the CrushWrapper editing surface.
+
+Computes the per-algorithm derived tables at insert time exactly as
+crush_make_*_bucket do (src/crush/builder.c): straw lengths (v0/v1
+crush_calc_straw, builder.c:431), tree node weights
+(crush_make_tree_bucket, builder.c:340), list prefix sums.  Name/type
+maps and add_simple_rule mirror CrushWrapper (CrushWrapper.cc
+add_simple_rule_at).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .mapper import crush_do_rule
+from .types import (
+    CRUSH_BUCKET_LIST,
+    CRUSH_BUCKET_STRAW,
+    CRUSH_BUCKET_STRAW2,
+    CRUSH_BUCKET_TREE,
+    CRUSH_BUCKET_UNIFORM,
+    CRUSH_RULE_CHOOSELEAF_FIRSTN,
+    CRUSH_RULE_CHOOSELEAF_INDEP,
+    CRUSH_RULE_CHOOSE_FIRSTN,
+    CRUSH_RULE_CHOOSE_INDEP,
+    CRUSH_RULE_EMIT,
+    CRUSH_RULE_SET_CHOOSELEAF_TRIES,
+    CRUSH_RULE_SET_CHOOSE_TRIES,
+    CRUSH_RULE_TAKE,
+    Bucket,
+    ChooseArg,
+    Rule,
+    RuleStep,
+    Tunables,
+)
+
+
+def _calc_straws(weights: list[int], version: int) -> list[int]:
+    """crush_calc_straw (builder.c:431-525): straw lengths such that
+    P(argmax_i hash16*straw_i = i) ∝ weight_i, computed by ascending-
+    weight sweep.  v1 fixes the equal-weight bookkeeping bug of v0."""
+    size = len(weights)
+    straws = [0] * size
+    if size == 0:
+        return straws
+    # ascending insertion order, stable (reverse sort by weight in the C)
+    order = sorted(range(size), key=lambda i: (weights[i], i))
+    numleft = size
+    straw = 1.0
+    wbelow = 0.0
+    lastw = 0.0
+    i = 0
+    while i < size:
+        if weights[order[i]] == 0:
+            straws[order[i]] = 0
+            i += 1
+            if version >= 1:
+                numleft -= 1
+            continue
+        straws[order[i]] = int(straw * 0x10000)
+        i += 1
+        if i == size:
+            break
+        if version == 0 and weights[order[i]] == weights[order[i - 1]]:
+            continue
+        wbelow += (weights[order[i - 1]] - lastw) * numleft
+        if version == 0:
+            j = i
+            while j < size and weights[order[j]] == weights[order[i]]:
+                numleft -= 1
+                j += 1
+        else:
+            numleft -= 1
+        wnext = numleft * (weights[order[i]] - weights[order[i - 1]])
+        pbelow = wbelow / (wbelow + wnext)
+        straw *= (1.0 / pbelow) ** (1.0 / numleft)
+        lastw = weights[order[i - 1]]
+    return straws
+
+
+def _calc_tree(weights: list[int]) -> list[int]:
+    """Implicit-binary-tree node weights (crush_make_tree_bucket,
+    builder.c:340-397): item i at node 2i+1; parents sum children."""
+    size = len(weights)
+    if size == 0:
+        return []
+    depth = 1
+    t = size - 1
+    while t:
+        t >>= 1
+        depth += 1
+    num_nodes = 1 << depth
+    node_weights = [0] * num_nodes
+    for i, wt in enumerate(weights):
+        node = (i + 1 << 1) - 1
+        node_weights[node] = wt
+        for _ in range(1, depth):
+            # parent: flip direction bit at this height
+            h = 0
+            n = node
+            while (n & 1) == 0:
+                h += 1
+                n >>= 1
+            if node & (1 << (h + 1)):
+                node = node - (1 << h)
+            else:
+                node = node + (1 << h)
+            node_weights[node] += wt
+    return node_weights
+
+
+@dataclass
+class CrushMap:
+    """Editable map + query API (the CrushWrapper role)."""
+
+    tunables: Tunables = field(default_factory=Tunables)
+    buckets: dict[int, Bucket] = field(default_factory=dict)
+    rules: list[Rule | None] = field(default_factory=list)
+    max_devices: int = 0
+    choose_args: dict[int, ChooseArg] = field(default_factory=dict)
+    # name maps (CrushWrapper name_map/type_map)
+    type_names: dict[int, str] = field(
+        default_factory=lambda: {0: "osd", 1: "host", 2: "rack", 3: "root"}
+    )
+    item_names: dict[int, str] = field(default_factory=dict)
+
+    def _name_to_item(self, name: str) -> int:
+        for item, n in self.item_names.items():
+            if n == name:
+                return item
+        raise KeyError(f"item {name!r} does not exist")
+
+    def _type_id(self, name: str) -> int:
+        for t, n in self.type_names.items():
+            if n == name:
+                return t
+        raise KeyError(f"type {name!r} does not exist")
+
+    # -- construction ------------------------------------------------------
+    def add_bucket(
+        self,
+        alg: int,
+        type: int,
+        items: list[int] | None = None,
+        weights: list[int] | None = None,
+        id: int | None = None,
+        name: str | None = None,
+        hash: int = 0,
+    ) -> int:
+        """crush_add_bucket + crush_make_bucket: computes derived tables
+        and registers the bucket.  Weights are 16.16 fixed point; device
+        items must be >= 0, sub-buckets already added."""
+        items = list(items or [])
+        weights = list(weights or [])
+        assert len(items) == len(weights)
+        if alg == CRUSH_BUCKET_UNIFORM and weights:
+            assert all(w == weights[0] for w in weights), (
+                "uniform buckets have one item weight"
+            )
+        if id is None:
+            id = min(self.buckets, default=0) - 1
+        assert id < 0 and id not in self.buckets
+        b = Bucket(
+            id=id,
+            type=type,
+            alg=alg,
+            items=items,
+            item_weights=weights,
+            hash=hash,
+            weight=sum(weights),
+        )
+        if alg == CRUSH_BUCKET_LIST:
+            acc, sums = 0, []
+            for w in weights:
+                acc += w
+                sums.append(acc)
+            b.sum_weights = sums
+        elif alg == CRUSH_BUCKET_TREE:
+            b.node_weights = _calc_tree(weights)
+        elif alg == CRUSH_BUCKET_STRAW:
+            b.straws = _calc_straws(
+                weights, self.tunables.straw_calc_version
+            )
+        self.buckets[id] = b
+        for item in items:
+            if item >= 0:
+                self.max_devices = max(self.max_devices, item + 1)
+        if name is not None:
+            self.item_names[id] = name
+        return id
+
+    def add_rule(self, rule: Rule, ruleno: int | None = None) -> int:
+        if ruleno is None:
+            ruleno = len(self.rules)
+        while len(self.rules) <= ruleno:
+            self.rules.append(None)
+        assert self.rules[ruleno] is None
+        self.rules[ruleno] = rule
+        rule.ruleset = ruleno
+        return ruleno
+
+    def add_simple_rule(
+        self,
+        name: str,
+        root_name: str,
+        failure_domain: str = "",
+        device_class: str = "",
+        mode: str = "firstn",
+        rule_type: int | None = None,
+    ) -> int:
+        """CrushWrapper::add_simple_rule_at semantics: TAKE root,
+        CHOOSELEAF over the failure domain (or CHOOSE osd for a flat
+        domain), EMIT; indep rules prepend SET_CHOOSELEAF_TRIES 5 and
+        SET_CHOOSE_TRIES 100.  Device classes need shadow trees (not
+        yet built — tracked in docs/PARITY.md)."""
+        assert mode in ("firstn", "indep"), mode
+        if device_class:
+            raise NotImplementedError("device-class shadow trees")
+        root = self._name_to_item(root_name)
+        dtype = self._type_id(failure_domain) if failure_domain else 0
+        steps: list[RuleStep] = []
+        if mode == "indep":
+            steps.append(RuleStep(CRUSH_RULE_SET_CHOOSELEAF_TRIES, 5))
+            steps.append(RuleStep(CRUSH_RULE_SET_CHOOSE_TRIES, 100))
+        steps.append(RuleStep(CRUSH_RULE_TAKE, root))
+        if dtype:
+            steps.append(
+                RuleStep(
+                    CRUSH_RULE_CHOOSELEAF_FIRSTN
+                    if mode == "firstn"
+                    else CRUSH_RULE_CHOOSELEAF_INDEP,
+                    0,
+                    dtype,
+                )
+            )
+        else:
+            steps.append(
+                RuleStep(
+                    CRUSH_RULE_CHOOSE_FIRSTN
+                    if mode == "firstn"
+                    else CRUSH_RULE_CHOOSE_INDEP,
+                    0,
+                    0,
+                )
+            )
+        steps.append(RuleStep(CRUSH_RULE_EMIT))
+        rule = Rule(
+            steps=steps,
+            type=1 if mode == "firstn" else 3,
+            min_size=1 if mode == "firstn" else 3,
+            max_size=10 if mode == "firstn" else 20,
+        )
+        ruleno = self.add_rule(rule)
+        self.item_names[1 << 16 | ruleno] = name  # rule name namespace
+        return ruleno
+
+    # -- query -------------------------------------------------------------
+    def find_rule(self, ruleset: int, type: int, size: int) -> int:
+        """crush_find_rule (mapper.c:41-54)."""
+        for i, r in enumerate(self.rules):
+            if (
+                r is not None
+                and r.ruleset == ruleset
+                and r.type == type
+                and r.min_size <= size <= r.max_size
+            ):
+                return i
+        return -1
+
+    def do_rule(
+        self,
+        ruleno: int,
+        x: int,
+        result_max: int,
+        weight: list[int] | None = None,
+        choose_args=None,
+    ) -> list[int]:
+        if weight is None:
+            weight = [0x10000] * self.max_devices
+        return crush_do_rule(
+            self, ruleno, x, result_max, weight, choose_args
+        )

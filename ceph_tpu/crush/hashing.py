@@ -1,0 +1,129 @@
+"""rjenkins1 hash — the only hash CRUSH uses (src/crush/hash.c).
+
+One numpy implementation serves scalars and batches: uint32 arithmetic
+wraps naturally, so results are byte-exact against crush_hash32_* for
+every arity (seed 1315423911, hash.c:24; mix rounds hash.c:12-22).
+
+The C macro ``crush_hashmix(a, b, c)`` mutates all three of its
+arguments in the caller's scope, and the x/y scratch values thread
+through successive mix calls — the rebinding chains below reproduce
+that dataflow exactly.
+
+Scalars in, python int out; arrays in, uint32 arrays out.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+CRUSH_HASH_RJENKINS1 = 0
+CRUSH_HASH_SEED = np.uint32(1315423911)
+
+_U32 = np.uint32
+_X0 = _U32(231232)
+_Y0 = _U32(1232)
+
+
+def _mix(a, b, c):
+    """One rjenkins mix round; returns updated (a, b, c).
+
+    uint32 wraparound is the point — silence numpy's scalar overflow
+    warnings."""
+    with np.errstate(over="ignore"):
+        return _mix_inner(a, b, c)
+
+
+def _mix_inner(a, b, c):
+    a = a - b
+    a = a - c
+    a = a ^ (c >> _U32(13))
+    b = b - c
+    b = b - a
+    b = b ^ (a << _U32(8))
+    c = c - a
+    c = c - b
+    c = c ^ (b >> _U32(13))
+    a = a - b
+    a = a - c
+    a = a ^ (c >> _U32(12))
+    b = b - c
+    b = b - a
+    b = b ^ (a << _U32(16))
+    c = c - a
+    c = c - b
+    c = c ^ (b >> _U32(5))
+    a = a - b
+    a = a - c
+    a = a ^ (c >> _U32(3))
+    b = b - c
+    b = b - a
+    b = b ^ (a << _U32(10))
+    c = c - a
+    c = c - b
+    c = c ^ (b >> _U32(15))
+    return a, b, c
+
+
+def _coerce(*vals):
+    arrs = [np.asarray(v).astype(np.uint32) for v in vals]
+    scalar = all(a.ndim == 0 for a in arrs)
+    return arrs, scalar
+
+
+def _ret(h, scalar):
+    return int(h) if scalar else h
+
+
+def crush_hash32(a):
+    (a,), scalar = _coerce(a)
+    h = CRUSH_HASH_SEED ^ a
+    b = a
+    b, x, h = _mix(b, _X0, h)
+    y, a, h = _mix(_Y0, a, h)
+    return _ret(h, scalar)
+
+
+def crush_hash32_2(a, b):
+    (a, b), scalar = _coerce(a, b)
+    h = CRUSH_HASH_SEED ^ a ^ b
+    a, b, h = _mix(a, b, h)
+    x, a, h = _mix(_X0, a, h)
+    b, y, h = _mix(b, _Y0, h)
+    return _ret(h, scalar)
+
+
+def crush_hash32_3(a, b, c):
+    (a, b, c), scalar = _coerce(a, b, c)
+    h = CRUSH_HASH_SEED ^ a ^ b ^ c
+    a, b, h = _mix(a, b, h)
+    c, x, h = _mix(c, _X0, h)
+    y, a, h = _mix(_Y0, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    return _ret(h, scalar)
+
+
+def crush_hash32_4(a, b, c, d):
+    (a, b, c, d), scalar = _coerce(a, b, c, d)
+    h = CRUSH_HASH_SEED ^ a ^ b ^ c ^ d
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    a, x, h = _mix(a, _X0, h)
+    y, b, h = _mix(_Y0, b, h)
+    c, x, h = _mix(c, x, h)
+    y, d, h = _mix(y, d, h)
+    return _ret(h, scalar)
+
+
+def crush_hash32_5(a, b, c, d, e):
+    (a, b, c, d, e), scalar = _coerce(a, b, c, d, e)
+    h = CRUSH_HASH_SEED ^ a ^ b ^ c ^ d ^ e
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    e, x, h = _mix(e, _X0, h)
+    y, a, h = _mix(_Y0, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    d, x, h = _mix(d, x, h)
+    y, e, h = _mix(y, e, h)
+    return _ret(h, scalar)

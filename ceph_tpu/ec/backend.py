@@ -18,6 +18,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..gf import matrix_vector_mul_region
+from ..layout import fold_stripes, unfold_stripes
 
 
 class NumpyBackend:
@@ -34,10 +35,9 @@ class NumpyBackend:
         """Batched (B, k, chunk) → (B, m, chunk): stripes fold into the
         region byte dimension (same layout as the jax backend)."""
         stripes = np.ascontiguousarray(stripes, dtype=np.uint8)
-        b, k, chunk = stripes.shape
-        flat = stripes.transpose(1, 0, 2).reshape(k, b * chunk)
-        out = matrix_vector_mul_region(matrix, flat, w)
-        return out.reshape(-1, b, chunk).transpose(1, 0, 2)
+        b, _k, chunk = stripes.shape
+        out = matrix_vector_mul_region(matrix, fold_stripes(stripes), w)
+        return unfold_stripes(out, b, chunk)
 
     def bitmatrix_regions(
         self,

@@ -1,0 +1,23 @@
+"""Stripe batch layout — the one definition of the fold both backends use.
+
+A stripe batch is (B, n, chunk_bytes); region math wants (n, bytes).
+Folding the batch into the byte axis keeps the per-stripe chunk layout
+and lets arbitrarily many stripes ride one kernel call (the hoisted
+ECUtil::encode per-stripe loop, src/osd/ECUtil.cc:123-162).
+
+Array-API generic: works on numpy and jax.numpy arrays alike.
+"""
+
+from __future__ import annotations
+
+
+def fold_stripes(stripes):
+    """(B, n, chunk) → (n, B*chunk)."""
+    b, n, chunk = stripes.shape
+    return stripes.transpose(1, 0, 2).reshape(n, b * chunk)
+
+
+def unfold_stripes(flat, batch: int, chunk: int):
+    """(m, B*chunk) → (B, m, chunk) (inverse of fold_stripes)."""
+    m = flat.shape[0]
+    return flat.reshape(m, batch, chunk).transpose(1, 0, 2)

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..layout import fold_stripes, unfold_stripes
 from .bitops import (
     pack_byte_bits,
     pack_word_bits,
@@ -96,11 +97,9 @@ def gf_matrix_stripes(
     The ECUtil::encode per-stripe loop (src/osd/ECUtil.cc:123-162) hoisted
     into one device call: stripes fold into the matmul N dimension, so
     arbitrarily many stripes ride a single kernel launch."""
-    b, k, chunk = stripes.shape
-    flat = stripes.transpose(1, 0, 2).reshape(k, b * chunk)
-    out = gf_matrix_regions(bm, flat, w=w)
-    m = out.shape[0]
-    return out.reshape(m, b, chunk).transpose(1, 0, 2)
+    b, _k, chunk = stripes.shape
+    out = gf_matrix_regions(bm, fold_stripes(stripes), w=w)
+    return unfold_stripes(out, b, chunk)
 
 
 @functools.lru_cache(maxsize=512)
