@@ -9,9 +9,10 @@ three tiers:
   (verified against the compiled reference C over all bucket
   algorithms; see tests/test_crush.py).
 - ``builder`` — map construction (builder.c / CrushWrapper equivalent).
-- ``jaxmap`` (in progress) — the batched device kernel: the whole map
-  compiled to dense arrays, straw2 + rule interpretation vmapped over
-  PGs (the ParallelPGMapper replacement; SURVEY.md §2.3).
+- ``jaxmap`` — the batched device kernel: the whole map compiled to
+  dense arrays, the rule program scalar-traced with lax control flow
+  and vmapped over PGs (the ParallelPGMapper replacement; SURVEY.md
+  §2.3).  Imported lazily: it enables jax x64 mode at import.
 """
 
 from .builder import CrushMap

@@ -123,6 +123,7 @@ class CrushMap:
         default_factory=lambda: {0: "osd", 1: "host", 2: "rack", 3: "root"}
     )
     item_names: dict[int, str] = field(default_factory=dict)
+    rule_names: dict[int, str] = field(default_factory=dict)
 
     def _name_to_item(self, name: str) -> int:
         for item, n in self.item_names.items():
@@ -251,7 +252,7 @@ class CrushMap:
             max_size=10 if mode == "firstn" else 20,
         )
         ruleno = self.add_rule(rule)
-        self.item_names[1 << 16 | ruleno] = name  # rule name namespace
+        self.rule_names[ruleno] = name
         return ruleno
 
     # -- query -------------------------------------------------------------

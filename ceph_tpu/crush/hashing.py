@@ -14,6 +14,8 @@ Scalars in, python int out; arrays in, uint32 arrays out.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 CRUSH_HASH_RJENKINS1 = 0
@@ -24,13 +26,15 @@ _X0 = _U32(231232)
 _Y0 = _U32(1232)
 
 
-def _mix(a, b, c):
-    """One rjenkins mix round; returns updated (a, b, c).
+def _suppress_overflow(fn):
+    """uint32 wraparound is the point; one errstate per hash call."""
 
-    uint32 wraparound is the point — silence numpy's scalar overflow
-    warnings."""
-    with np.errstate(over="ignore"):
-        return _mix_inner(a, b, c)
+    @functools.wraps(fn)
+    def wrapped(*args):
+        with np.errstate(over="ignore"):
+            return fn(*args)
+
+    return wrapped
 
 
 def _mix_inner(a, b, c):
@@ -74,56 +78,61 @@ def _ret(h, scalar):
     return int(h) if scalar else h
 
 
+@_suppress_overflow
 def crush_hash32(a):
     (a,), scalar = _coerce(a)
     h = CRUSH_HASH_SEED ^ a
     b = a
-    b, x, h = _mix(b, _X0, h)
-    y, a, h = _mix(_Y0, a, h)
+    b, x, h = _mix_inner(b, _X0, h)
+    y, a, h = _mix_inner(_Y0, a, h)
     return _ret(h, scalar)
 
 
+@_suppress_overflow
 def crush_hash32_2(a, b):
     (a, b), scalar = _coerce(a, b)
     h = CRUSH_HASH_SEED ^ a ^ b
-    a, b, h = _mix(a, b, h)
-    x, a, h = _mix(_X0, a, h)
-    b, y, h = _mix(b, _Y0, h)
+    a, b, h = _mix_inner(a, b, h)
+    x, a, h = _mix_inner(_X0, a, h)
+    b, y, h = _mix_inner(b, _Y0, h)
     return _ret(h, scalar)
 
 
+@_suppress_overflow
 def crush_hash32_3(a, b, c):
     (a, b, c), scalar = _coerce(a, b, c)
     h = CRUSH_HASH_SEED ^ a ^ b ^ c
-    a, b, h = _mix(a, b, h)
-    c, x, h = _mix(c, _X0, h)
-    y, a, h = _mix(_Y0, a, h)
-    b, x, h = _mix(b, x, h)
-    y, c, h = _mix(y, c, h)
+    a, b, h = _mix_inner(a, b, h)
+    c, x, h = _mix_inner(c, _X0, h)
+    y, a, h = _mix_inner(_Y0, a, h)
+    b, x, h = _mix_inner(b, x, h)
+    y, c, h = _mix_inner(y, c, h)
     return _ret(h, scalar)
 
 
+@_suppress_overflow
 def crush_hash32_4(a, b, c, d):
     (a, b, c, d), scalar = _coerce(a, b, c, d)
     h = CRUSH_HASH_SEED ^ a ^ b ^ c ^ d
-    a, b, h = _mix(a, b, h)
-    c, d, h = _mix(c, d, h)
-    a, x, h = _mix(a, _X0, h)
-    y, b, h = _mix(_Y0, b, h)
-    c, x, h = _mix(c, x, h)
-    y, d, h = _mix(y, d, h)
+    a, b, h = _mix_inner(a, b, h)
+    c, d, h = _mix_inner(c, d, h)
+    a, x, h = _mix_inner(a, _X0, h)
+    y, b, h = _mix_inner(_Y0, b, h)
+    c, x, h = _mix_inner(c, x, h)
+    y, d, h = _mix_inner(y, d, h)
     return _ret(h, scalar)
 
 
+@_suppress_overflow
 def crush_hash32_5(a, b, c, d, e):
     (a, b, c, d, e), scalar = _coerce(a, b, c, d, e)
     h = CRUSH_HASH_SEED ^ a ^ b ^ c ^ d ^ e
-    a, b, h = _mix(a, b, h)
-    c, d, h = _mix(c, d, h)
-    e, x, h = _mix(e, _X0, h)
-    y, a, h = _mix(_Y0, a, h)
-    b, x, h = _mix(b, x, h)
-    y, c, h = _mix(y, c, h)
-    d, x, h = _mix(d, x, h)
-    y, e, h = _mix(y, e, h)
+    a, b, h = _mix_inner(a, b, h)
+    c, d, h = _mix_inner(c, d, h)
+    e, x, h = _mix_inner(e, _X0, h)
+    y, a, h = _mix_inner(_Y0, a, h)
+    b, x, h = _mix_inner(b, x, h)
+    y, c, h = _mix_inner(y, c, h)
+    d, x, h = _mix_inner(d, x, h)
+    y, e, h = _mix_inner(y, e, h)
     return _ret(h, scalar)

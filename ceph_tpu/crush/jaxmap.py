@@ -1,0 +1,596 @@
+"""Batched CRUSH on device — the ParallelPGMapper replacement.
+
+The reference recomputes every PG's placement by sharding pgid ranges
+over a thread pool (src/osd/OSDMapMapping.h:18-156); here the whole map
+compiles to dense arrays and ``crush_do_rule`` becomes a scalar-traced
+function vmapped over the PG batch: one device call maps a million PGs.
+
+Scope (v1): straw2 hierarchies (every bucket alg CRUSH_BUCKET_STRAW2 —
+the modern default and the 10k-OSD benchmark config), tunables with
+choose_local_tries == choose_local_fallback_tries == 0 (true of every
+profile since bobtail), rule programs of [SET_*...] TAKE CHOOSE[LEAF]
+EMIT groups.  Anything else raises UnsupportedMap and callers fall back
+to the exact Python oracle (ceph_tpu.crush.mapper) — the same
+plugin-style split the EC backends use.
+
+Exactness: int64 fixed-point draws (jax_enable_x64 required — enabled
+at import), identical hash/ln tables, and the same r'-advancement and
+retry semantics as mapper.c; verified against the oracle in
+tests/test_crush_jax.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
+
+from .hashing import _mix_inner  # noqa: E402
+from .ln import _tables as _ln_tables  # noqa: E402
+from .types import (  # noqa: E402
+    CRUSH_BUCKET_STRAW2,
+    CRUSH_ITEM_NONE,
+    CRUSH_ITEM_UNDEF,
+    CRUSH_RULE_CHOOSELEAF_FIRSTN,
+    CRUSH_RULE_CHOOSELEAF_INDEP,
+    CRUSH_RULE_CHOOSE_FIRSTN,
+    CRUSH_RULE_CHOOSE_INDEP,
+    CRUSH_RULE_EMIT,
+    CRUSH_RULE_SET_CHOOSELEAF_TRIES,
+    CRUSH_RULE_SET_CHOOSELEAF_VARY_R,
+    CRUSH_RULE_SET_CHOOSELEAF_STABLE,
+    CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES,
+    CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES,
+    CRUSH_RULE_SET_CHOOSE_TRIES,
+    CRUSH_RULE_TAKE,
+)
+
+MAX_DEPTH = 16  # CRUSH_MAX_DEPTH is 10; headroom is free in a fori
+
+# descend status codes
+_FOUND, _EMPTY, _BAD = 0, 1, 2
+
+
+class UnsupportedMap(ValueError):
+    """Map/rule shape outside the device kernel's scope; use the oracle."""
+
+
+# -- device-side primitives ------------------------------------------------
+
+
+def _hash3(a, b, c):
+    """rjenkins1 arity 3 on uint32 jnp values (hash.c:48-59)."""
+    h = jnp.uint32(1315423911) ^ a ^ b ^ c
+    x0, y0 = jnp.uint32(231232), jnp.uint32(1232)
+    a, b, h = _mix_inner(a, b, h)
+    c, x, h = _mix_inner(c, x0, h)
+    y, a, h = _mix_inner(y0, a, h)
+    b, x, h = _mix_inner(b, x, h)
+    y, c, h = _mix_inner(y, c, h)
+    return h.astype(jnp.uint32)
+
+
+def _hash2(a, b):
+    """rjenkins1 arity 2 (hash.c:37-46)."""
+    h = jnp.uint32(1315423911) ^ a ^ b
+    x0, y0 = jnp.uint32(231232), jnp.uint32(1232)
+    a, b, h = _mix_inner(a, b, h)
+    x, a, h = _mix_inner(x0, a, h)
+    b, y, h = _mix_inner(b, y0, h)
+    return h.astype(jnp.uint32)
+
+
+@functools.lru_cache(maxsize=1)
+def _ln_consts():
+    # plain numpy int64 — jnp would cache trace-scoped tracers here
+    rh, lh, ll = _ln_tables()
+    return rh, lh, ll
+
+
+def _crush_ln(u):
+    """2^44*log2(u+1) in fixed point (mapper.c:248-290), u uint32."""
+    rh, lh, ll = _ln_consts()
+    rh_tbl = jnp.asarray(rh, dtype=jnp.int64)
+    lh_tbl = jnp.asarray(lh, dtype=jnp.int64)
+    ll_tbl = jnp.asarray(ll, dtype=jnp.int64)
+    x = u.astype(jnp.int64) + 1
+    masked = x & 0x1FFFF
+    nbits = jnp.zeros_like(x)
+    for shift in (16, 8, 4, 2, 1):
+        step = (masked >> shift) != 0
+        nbits = nbits + jnp.where(step, shift, 0)
+        masked = jnp.where(step, masked >> shift, masked)
+    bitlen = nbits + (masked != 0)
+    shift_amt = jnp.where((x & 0x18000) == 0, 16 - bitlen, 0)
+    x = x << shift_amt
+    iexpon = 15 - shift_amt
+    k = ((x >> 8) << 1) - 256 >> 1
+    # x*RH reaches 2^63; like the C, only the wrapped low bits feed index2
+    xl64 = (x * rh_tbl[k]) >> 48
+    index2 = xl64 & 0xFF
+    return (iexpon << 44) + ((lh_tbl[k] + ll_tbl[index2]) >> 4)
+
+
+# -- map compilation -------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class CompiledMap:
+    """Dense-array rendering of a CrushMap for the device kernel."""
+
+    items: jnp.ndarray  # (nb, sz) int32 — bucket members (neg = bucket)
+    weights: jnp.ndarray  # (nb, sz) int64 — 16.16 straw2 weights
+    sizes: jnp.ndarray  # (nb,) int32
+    types: jnp.ndarray  # (nb,) int32
+    bidx: jnp.ndarray  # (max_neg,) int32 — (-1-id) -> bucket row, -1 gap
+    max_devices: int
+    tunables: tuple  # (total_tries, descend_once, vary_r, stable)
+    rules: tuple  # immutable rule description for cache keys
+
+    def __hash__(self):
+        return hash((id(self.items), self.rules, self.tunables))
+
+    def __eq__(self, other):
+        return self is other
+
+
+def compile_map(cmap) -> CompiledMap:
+    """CrushMap -> dense arrays; raises UnsupportedMap outside scope."""
+    t = cmap.tunables
+    if t.choose_local_tries or t.choose_local_fallback_tries:
+        raise UnsupportedMap(
+            "choose_local_(fallback_)tries != 0 needs the legacy perm "
+            "fallback; use the oracle"
+        )
+    if not cmap.buckets:
+        raise UnsupportedMap("empty map")
+    for b in cmap.buckets.values():
+        if b.alg != CRUSH_BUCKET_STRAW2:
+            raise UnsupportedMap(
+                f"bucket {b.id} alg {b.alg}: device kernel is straw2-only"
+            )
+    if cmap.choose_args:
+        raise UnsupportedMap("choose_args not yet in the device kernel")
+
+    nb = len(cmap.buckets)
+    sz = max(b.size for b in cmap.buckets.values())
+    sz = max(sz, 1)
+    items = np.zeros((nb, sz), dtype=np.int32)
+    weights = np.zeros((nb, sz), dtype=np.int64)
+    sizes = np.zeros(nb, dtype=np.int32)
+    types = np.zeros(nb, dtype=np.int32)
+    max_neg = max(-b.id for b in cmap.buckets.values())
+    bidx = np.full(max_neg, -1, dtype=np.int32)
+    for row, b in enumerate(sorted(cmap.buckets.values(), key=lambda b: -b.id)):
+        items[row, : b.size] = b.items
+        weights[row, : b.size] = b.item_weights
+        sizes[row] = b.size
+        types[row] = b.type
+        bidx[-1 - b.id] = row
+
+    rules = []
+    for rule in cmap.rules:
+        rules.append(None if rule is None else _compile_rule(rule))
+
+    return CompiledMap(
+        items=jnp.asarray(items),
+        weights=jnp.asarray(weights),
+        sizes=jnp.asarray(sizes),
+        types=jnp.asarray(types),
+        bidx=jnp.asarray(bidx),
+        max_devices=cmap.max_devices,
+        tunables=(
+            t.choose_total_tries + 1,
+            t.chooseleaf_descend_once,
+            t.chooseleaf_vary_r,
+            t.chooseleaf_stable,
+        ),
+        rules=tuple(rules),
+    )
+
+
+def _compile_rule(rule):
+    """Rule -> tuple of (op, arg1, arg2) groups: [set-overrides..., take,
+    choose, emit] repeated; raises UnsupportedMap on other shapes."""
+    groups = []
+    overrides = {}
+    take = None
+    choose = None
+    for step in rule.steps:
+        if step.op in (
+            CRUSH_RULE_SET_CHOOSE_TRIES,
+            CRUSH_RULE_SET_CHOOSELEAF_TRIES,
+            CRUSH_RULE_SET_CHOOSELEAF_VARY_R,
+            CRUSH_RULE_SET_CHOOSELEAF_STABLE,
+            CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES,
+            CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES,
+        ):
+            if step.op in (
+                CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES,
+                CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES,
+            ):
+                if step.arg1 > 0:
+                    raise UnsupportedMap("local tries override")
+                continue
+            overrides[step.op] = step.arg1
+        elif step.op == CRUSH_RULE_TAKE:
+            take = step.arg1
+        elif step.op in (
+            CRUSH_RULE_CHOOSE_FIRSTN,
+            CRUSH_RULE_CHOOSELEAF_FIRSTN,
+            CRUSH_RULE_CHOOSE_INDEP,
+            CRUSH_RULE_CHOOSELEAF_INDEP,
+        ):
+            if take is None or choose is not None:
+                raise UnsupportedMap("rule shape: choose without take")
+            choose = (step.op, step.arg1, step.arg2)
+        elif step.op == CRUSH_RULE_EMIT:
+            if take is None or choose is None:
+                raise UnsupportedMap("rule shape: emit without choose")
+            groups.append(
+                (take, choose, tuple(sorted(overrides.items())))
+            )
+            take = choose = None
+        else:
+            raise UnsupportedMap(f"rule op {step.op}")
+    if take is not None or choose is not None:
+        raise UnsupportedMap("rule does not end with EMIT")
+    return tuple(groups)
+
+
+# -- the kernel ------------------------------------------------------------
+
+
+def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
+    """Build the scalar-traced do_rule for one (map, rule, result_max)."""
+    groups = cm.rules[ruleno]
+    if groups is None:
+        raise UnsupportedMap(f"no rule {ruleno}")
+    total_tries, descend_once, vary_r_t, stable_t = cm.tunables
+    NONE = jnp.int32(CRUSH_ITEM_NONE)
+    UNDEF = jnp.int32(CRUSH_ITEM_UNDEF)
+    S64_MIN = jnp.int64(-(1 << 63))
+
+    def straw2(bidx_row, x, r):
+        """One straw2 draw-argmax (mapper.c:361-384)."""
+        ids = cm.items[bidx_row]
+        ws = cm.weights[bidx_row]
+        slot = jnp.arange(ids.shape[0])
+        u = (
+            _hash3(
+                jnp.uint32(x),
+                ids.astype(jnp.uint32),
+                jnp.uint32(r),
+            ).astype(jnp.int64)
+            & 0xFFFF
+        )
+        ln = _crush_ln(u.astype(jnp.uint32)) - jnp.int64(0x1000000000000)
+        draw = jnp.where(
+            ws > 0, -((-ln) // jnp.maximum(ws, 1)), S64_MIN
+        )
+        draw = jnp.where(slot < cm.sizes[bidx_row], draw, S64_MIN)
+        return ids[jnp.argmax(draw)]
+
+    def row_of(item):
+        """Bucket row for a (negative) item; -1 if invalid."""
+        neg = -1 - item
+        ok = (item < 0) & (neg < cm.bidx.shape[0])
+        return jnp.where(ok, cm.bidx[jnp.clip(neg, 0, None)], -1)
+
+    def descend(start_row, x, r, ttype):
+        """Walk intermediate buckets until an item of ttype
+        (mapper.c firstn/indep inner descent; r is constant per level
+        for straw2).  Returns (item, status)."""
+
+        def body(_, st):
+            cur_row, item, status, done = st
+            empty = cm.sizes[cur_row] == 0
+            nitem = straw2(cur_row, x, r)
+            bad_dev = nitem >= cm.max_devices
+            nrow = row_of(nitem)
+            ntype = jnp.where(nitem >= 0, 0, cm.types[jnp.maximum(nrow, 0)])
+            invalid = (nitem < 0) & (nrow < 0)
+            found = (~empty) & (~bad_dev) & (~invalid) & (ntype == ttype)
+            bad = (~empty) & (bad_dev | ((ntype != ttype) & ((nitem >= 0) | invalid)))
+            nstatus = jnp.where(
+                empty,
+                _EMPTY,
+                jnp.where(found, _FOUND, jnp.where(bad, _BAD, status)),
+            )
+            ndone = empty | found | bad
+            keep = done
+            return (
+                jnp.where(keep | ndone, cur_row, nrow),
+                jnp.where(keep, item, nitem),
+                jnp.where(keep, status, nstatus),
+                keep | ndone,
+            )
+
+        init = (start_row, jnp.int32(0), jnp.int32(_BAD), jnp.bool_(False))
+        _, item, status, done = lax.fori_loop(0, MAX_DEPTH, body, init)
+        return item, jnp.where(done, status, _BAD)
+
+    def is_out(weightv, item, x):
+        """mapper.c:424-438 over the device reweight vector."""
+        w = weightv[jnp.clip(item, 0, weightv.shape[0] - 1)]
+        oob = item >= weightv.shape[0]
+        hashed = (
+            _hash2(jnp.uint32(x), jnp.uint32(item)).astype(jnp.int64)
+            & 0xFFFF
+        )
+        return oob | (w == 0) | ((w < 0x10000) & (hashed >= w))
+
+    def leaf_firstn(domain_item, x, sub_r, out2, outpos, weightv, tries, stable):
+        """Inner chooseleaf: one leaf under domain_item (the recursive
+        crush_choose_firstn with numrep=1/outpos+1, type=0)."""
+        rep = jnp.where(stable, 0, outpos)
+        drow = row_of(domain_item)
+
+        def cond(st):
+            ftotal, _, placed, skip = st
+            return (~placed) & (~skip)
+
+        def body(st):
+            ftotal, _, _, _ = st
+            r = rep + sub_r + ftotal
+            item, status = descend(drow, x, r, 0)
+            ok = status == _FOUND
+            collide = jnp.any(
+                (jnp.arange(out2.shape[0]) < outpos) & (out2 == item)
+            )
+            rejected = ok & (collide | is_out(weightv, item, x))
+            placed = ok & (~rejected)
+            # EMPTY and reject both advance ftotal; BAD skips the rep
+            skip = (status == _BAD) | (
+                (~placed) & (ftotal + 1 >= tries)
+            )
+            return (ftotal + 1, item, placed, skip)
+
+        _, item, placed, _ = lax.while_loop(
+            cond, body, (jnp.int32(0), jnp.int32(0), jnp.bool_(False), jnp.bool_(False))
+        )
+        return item, placed
+
+    def choose_firstn(take_row, x, numrep, ttype, leaf, weightv, tries, leaf_tries, vary_r, stable):
+        """Top-level crush_choose_firstn (outpos=0 frame)."""
+        out = jnp.full((numrep,), NONE, dtype=jnp.int32)
+        out2 = jnp.full((numrep,), NONE, dtype=jnp.int32)
+        outpos = jnp.int32(0)
+
+        for rep in range(numrep):
+
+            def cond(st):
+                ftotal, _, _, placed, skip = st
+                return (~placed) & (~skip)
+
+            def body(st, _rep=rep):
+                ftotal, _, _, _, _ = st
+                r = _rep + ftotal
+                item, status = descend(take_row, x, r, ttype)
+                ok = status == _FOUND
+                collide = ok & jnp.any(
+                    (jnp.arange(numrep) < outpos) & (out == item)
+                )
+                reject = jnp.bool_(False)
+                leaf_item = jnp.int32(0)
+                if leaf:
+                    sub_r = (r >> (vary_r - 1)) if vary_r else jnp.int32(0)
+                    is_bucket = item < 0
+                    li, got = leaf_firstn(
+                        jnp.where(is_bucket, item, jnp.int32(-1)),
+                        x,
+                        sub_r,
+                        out2,
+                        outpos,
+                        weightv,
+                        leaf_tries,
+                        stable,
+                    )
+                    leaf_item = jnp.where(is_bucket, li, item)
+                    reject = ok & (~collide) & is_bucket & (~got)
+                if ttype == 0:
+                    reject = reject | (
+                        ok & (~collide) & is_out(weightv, item, x)
+                    )
+                placed = ok & (~collide) & (~reject)
+                skip = (status == _BAD) | (
+                    (~placed) & (ftotal + 1 >= tries)
+                )
+                return (ftotal + 1, item, leaf_item, placed, skip)
+
+            init = (
+                jnp.int32(0),
+                jnp.int32(0),
+                jnp.int32(0),
+                jnp.bool_(False),
+                jnp.bool_(False),
+            )
+            _, item, leaf_item, placed, _ = lax.while_loop(cond, body, init)
+            out = jnp.where(
+                placed & (jnp.arange(numrep) == outpos), item, out
+            )
+            if leaf:
+                out2 = jnp.where(
+                    placed & (jnp.arange(numrep) == outpos), leaf_item, out2
+                )
+            outpos = outpos + placed.astype(jnp.int32)
+
+        return (out2 if leaf else out), outpos
+
+    def leaf_indep(domain_item, x, rep, parent_r, numrep, weightv, tries):
+        """Inner chooseleaf indep: the recursive call with left=1 at
+        slot ``rep`` (outpos=rep), so r' = rep + parent_r + n*ftotal';
+        no collisions possible in a one-slot region."""
+        drow = row_of(domain_item)
+
+        def cond(st):
+            ftotal, item = st
+            return (item == UNDEF) & (ftotal < tries)
+
+        def body(st):
+            ftotal, _ = st
+            r = rep + parent_r + numrep * ftotal
+            item, status = descend(drow, x, r, 0)
+            ok = (status == _FOUND) & ~is_out(weightv, item, x)
+            bad = status == _BAD
+            nitem = jnp.where(ok, item, jnp.where(bad, NONE, UNDEF))
+            return (ftotal + 1, nitem)
+
+        _, item = lax.while_loop(cond, body, (jnp.int32(0), UNDEF))
+        return jnp.where(item == UNDEF, NONE, item)
+
+    def choose_indep(take_row, x, left0, numrep, ttype, leaf, weightv, tries, leaf_tries):
+        """Top-level crush_choose_indep (outpos=0 frame, left0 slots;
+        ``numrep`` is the unclamped replica count — it sets the r'
+        stride even when left0 < numrep)."""
+        out = jnp.full((left0,), UNDEF, dtype=jnp.int32)
+        out2 = jnp.full((left0,), UNDEF, dtype=jnp.int32)
+
+        def cond(st):
+            out, _, left, ftotal = st
+            return (left > 0) & (ftotal < tries)
+
+        def body(st):
+            out, out2, left, ftotal = st
+            for rep in range(left0):
+                undef = out[rep] == UNDEF
+                r = rep + numrep * ftotal
+                item, status = descend(take_row, x, r, ttype)
+                ok = status == _FOUND
+                hard_bad = status == _BAD
+                collide = ok & jnp.any(out == item)
+                leaf_ok = jnp.bool_(True)
+                leaf_item = item
+                if leaf:
+                    is_bucket = item < 0
+                    li = leaf_indep(
+                        jnp.where(is_bucket, item, jnp.int32(-1)),
+                        x,
+                        rep,
+                        r,
+                        numrep,
+                        weightv,
+                        leaf_tries,
+                    )
+                    leaf_item = jnp.where(is_bucket, li, item)
+                    leaf_ok = jnp.where(is_bucket, li != NONE, True)
+                outed = (
+                    ok & (ttype == 0) & is_out(weightv, item, x)
+                    if ttype == 0
+                    else jnp.bool_(False)
+                )
+                place = undef & ok & (~collide) & leaf_ok & (~outed)
+                kill = undef & hard_bad  # slot permanently NONE
+                sel = jnp.arange(left0) == rep
+                out = jnp.where(
+                    sel & place, item, jnp.where(sel & kill, NONE, out)
+                )
+                if leaf:
+                    out2 = jnp.where(
+                        sel & place,
+                        leaf_item,
+                        jnp.where(sel & kill, NONE, out2),
+                    )
+                left = left - (place | kill).astype(jnp.int32)
+            return (out, out2, left, ftotal + 1)
+
+        out, out2, _, _ = lax.while_loop(
+            cond, body, (out, out2, jnp.int32(left0), jnp.int32(0))
+        )
+        out = jnp.where(out == UNDEF, NONE, out)
+        out2 = jnp.where(out2 == UNDEF, NONE, out2)
+        return (out2 if leaf else out), jnp.int32(left0)
+
+    def rule_fn(x, weightv):
+        """Full do_rule for one x; returns (result, count) padded with
+        NONE to result_max."""
+        result = jnp.full((result_max,), NONE, dtype=jnp.int32)
+        rlen = jnp.int32(0)
+        for take, (op, arg1, arg2), overrides in groups:
+            ov = dict(overrides)
+            tries = ov.get(CRUSH_RULE_SET_CHOOSE_TRIES, total_tries)
+            leaf_override = ov.get(CRUSH_RULE_SET_CHOOSELEAF_TRIES, 0)
+            vary_r = ov.get(CRUSH_RULE_SET_CHOOSELEAF_VARY_R, vary_r_t)
+            stable = ov.get(CRUSH_RULE_SET_CHOOSELEAF_STABLE, stable_t)
+            numrep = arg1 if arg1 > 0 else result_max + arg1
+            if numrep <= 0:
+                continue
+            # slots are bounded by result_max (the C bounds firstn by
+            # count and indep by out_size); the r' stride keeps the
+            # unclamped numrep
+            nslots = min(numrep, result_max)
+            if take >= 0:
+                raise UnsupportedMap("TAKE of a device (not a bucket)")
+            if -1 - take >= cm.bidx.shape[0]:
+                raise UnsupportedMap(f"TAKE of unknown bucket {take}")
+            take_row = int(np.asarray(cm.bidx)[-1 - take])
+            if take_row < 0:
+                raise UnsupportedMap(f"TAKE of unknown bucket {take}")
+            firstn = op in (
+                CRUSH_RULE_CHOOSE_FIRSTN,
+                CRUSH_RULE_CHOOSELEAF_FIRSTN,
+            )
+            leaf = op in (
+                CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                CRUSH_RULE_CHOOSELEAF_INDEP,
+            )
+            if firstn:
+                if leaf_override:
+                    leaf_tries = leaf_override
+                elif descend_once:
+                    leaf_tries = 1
+                else:
+                    leaf_tries = tries
+                got, n = choose_firstn(
+                    take_row, x, nslots, arg2, leaf, weightv,
+                    tries, leaf_tries, vary_r, stable,
+                )
+            else:
+                leaf_tries = leaf_override if leaf_override else 1
+                got, n = choose_indep(
+                    take_row, x, nslots, numrep, arg2, leaf, weightv,
+                    tries, leaf_tries,
+                )
+            # append got[:n] to result at rlen
+            for i in range(nslots):
+                slot = rlen + i
+                valid = (i < n) & (slot < result_max)
+                result = jnp.where(
+                    valid & (jnp.arange(result_max) == slot),
+                    got[i],
+                    result,
+                )
+            rlen = jnp.minimum(rlen + n, result_max)
+        return result, rlen
+
+    return rule_fn
+
+
+@functools.lru_cache(maxsize=64)
+def _batched(cm: CompiledMap, ruleno: int, result_max: int):
+    fn = _make_rule_fn(cm, ruleno, result_max)
+    return jax.jit(jax.vmap(fn, in_axes=(0, None)))
+
+
+def batch_do_rule(
+    cm: CompiledMap,
+    ruleno: int,
+    xs,
+    result_max: int,
+    weights=None,
+):
+    """Map a batch of inputs: xs (N,) -> (results (N, result_max) int32
+    padded with CRUSH_ITEM_NONE, counts (N,)).  ``weights`` is the
+    16.16 device reweight vector."""
+    if weights is None:
+        weights = np.full(max(cm.max_devices, 1), 0x10000, np.int64)
+    xs = jnp.asarray(xs, dtype=jnp.int32)
+    wv = jnp.asarray(weights, dtype=jnp.int64)
+    return _batched(cm, ruleno, result_max)(xs, wv)

@@ -71,17 +71,21 @@ def _tables() -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     rh = np.array(
         [-((-(1 << 55)) // (128 + k)) for k in range(129)], dtype=np.int64
     )
-    decimal.getcontext().prec = 60
-    ln2 = decimal.Decimal(2).ln()
-    lh = np.array(
-        [
-            int((decimal.Decimal(128 + k).ln() - decimal.Decimal(128).ln())
-                / ln2 * (1 << 48))
-            for k in range(128)
-        ]
-        + [0xFFFF00000000],
-        dtype=np.int64,
-    )
+    with decimal.localcontext() as ctx:
+        ctx.prec = 60
+        ln2 = decimal.Decimal(2).ln()
+        lh = np.array(
+            [
+                int(
+                    (decimal.Decimal(128 + k).ln()
+                     - decimal.Decimal(128).ln())
+                    / ln2 * (1 << 48)
+                )
+                for k in range(128)
+            ]
+            + [0xFFFF00000000],
+            dtype=np.int64,
+        )
     ll = np.frombuffer(base64.b85decode(_LL_B85), dtype="<u8").astype(
         np.int64
     )

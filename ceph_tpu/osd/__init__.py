@@ -1,0 +1,14 @@
+"""OSD cluster-map layer: pools, OSD state, and PG→OSD mapping.
+
+The reference's OSDMap (src/osd/OSDMap.{h,cc}) is an epoch-versioned
+cluster map whose hot path is ``pg_to_up_acting_osds`` — re-rendered
+here as a scalar oracle (``osdmap``) plus a batched device pipeline
+(``mapping``) that recomputes every PG of every pool in one call per
+pool (the OSDMapMapping/ParallelPGMapper replacement,
+src/osd/OSDMapMapping.h:18-156).
+"""
+
+from .osdmap import OSDMap, PgPool
+from .mapping import OSDMapMapping
+
+__all__ = ["OSDMap", "OSDMapMapping", "PgPool"]

@@ -1,0 +1,269 @@
+"""OSDMap — cluster map + the scalar PG→OSD mapping oracle.
+
+Pipeline semantics re-derived from src/osd/OSDMap.cc:
+``pg_to_up_acting_osds`` (:2668) = raw_pg_to_pps seed → crush do_rule
+(_pg_to_raw_osds :2436) → _apply_upmap (:2466) → _raw_to_up_osds
+(:2513) → _pick_primary (:2456) → _apply_primary_affinity (:2540) →
+_get_temp_osds (:2593).  PG seeds: pg_pool_t::raw_pg_to_pps
+(src/osd/osd_types.cc:1793) with ceph_stable_mod
+(src/include/rados.h:96-102) keeping splits stable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..crush.builder import CrushMap
+from ..crush.hashing import crush_hash32_2
+from ..crush.types import (
+    CRUSH_ITEM_NONE,
+    PG_POOL_TYPE_ERASURE,
+    PG_POOL_TYPE_REPLICATED,
+)
+
+CEPH_OSD_DEFAULT_PRIMARY_AFFINITY = 0x10000
+CEPH_OSD_MAX_PRIMARY_AFFINITY = 0x10000
+
+
+def ceph_stable_mod(x: int, b: int, bmask: int) -> int:
+    """Stable modulo: values keep their slot across pg_num doublings
+    (src/include/rados.h:96-102)."""
+    if (x & bmask) < b:
+        return x & bmask
+    return x & (bmask >> 1)
+
+
+def _pg_mask(n: int) -> int:
+    """Smallest all-ones mask covering [0, n) (pg_pool_t pg_num_mask)."""
+    return (1 << max(n - 1, 0).bit_length()) - 1
+
+
+@dataclass
+class PgPool:
+    """pg_pool_t subset relevant to mapping (src/osd/osd_types.h)."""
+
+    pool_id: int
+    type: int = PG_POOL_TYPE_REPLICATED
+    size: int = 3
+    min_size: int = 2
+    pg_num: int = 32
+    pgp_num: int = 0  # defaults to pg_num
+    crush_rule: int = 0
+    erasure_code_profile: str = ""
+    hashpspool: bool = True
+
+    def __post_init__(self):
+        if not self.pgp_num:
+            self.pgp_num = self.pg_num
+
+    @property
+    def pg_num_mask(self) -> int:
+        return _pg_mask(self.pg_num)
+
+    @property
+    def pgp_num_mask(self) -> int:
+        return _pg_mask(self.pgp_num)
+
+    def can_shift_osds(self) -> bool:
+        """Replicated mappings compact holes; EC keeps positions."""
+        return self.type == PG_POOL_TYPE_REPLICATED
+
+    def raw_pg_to_pg_seed(self, ps: int) -> int:
+        """raw ps → stable pg seed (pg_pool_t::raw_pg_to_pg)."""
+        return ceph_stable_mod(ps, self.pg_num, self.pg_num_mask)
+
+    def raw_pg_to_pps(self, ps: int) -> int:
+        """Placement seed fed to CRUSH (osd_types.cc:1793-1809)."""
+        m = ceph_stable_mod(ps, self.pgp_num, self.pgp_num_mask)
+        if self.hashpspool:
+            return crush_hash32_2(m, self.pool_id & 0xFFFFFFFF)
+        return m + self.pool_id
+
+
+@dataclass
+class OSDMap:
+    """Cluster map: OSD state vectors + pools + the crush map.
+
+    pg ids are (pool_id, ps) tuples; override maps are keyed by the
+    stable pg seed like the reference's pg_t keys."""
+
+    crush: CrushMap
+    max_osd: int = 0
+    epoch: int = 1
+    pools: dict[int, PgPool] = field(default_factory=dict)
+    osd_exists: list[bool] = field(default_factory=list)
+    osd_up: list[bool] = field(default_factory=list)
+    osd_weight: list[int] = field(default_factory=list)  # 16.16 reweight
+    osd_primary_affinity: list[int] | None = None  # 16.16, None = defaults
+    pg_temp: dict[tuple[int, int], list[int]] = field(default_factory=dict)
+    primary_temp: dict[tuple[int, int], int] = field(default_factory=dict)
+    pg_upmap: dict[tuple[int, int], list[int]] = field(default_factory=dict)
+    pg_upmap_items: dict[tuple[int, int], list[tuple[int, int]]] = field(
+        default_factory=dict
+    )
+
+    @classmethod
+    def build(cls, crush: CrushMap, num_osd: int) -> OSDMap:
+        return cls(
+            crush=crush,
+            max_osd=num_osd,
+            osd_exists=[True] * num_osd,
+            osd_up=[True] * num_osd,
+            osd_weight=[0x10000] * num_osd,
+        )
+
+    def add_pool(self, pool: PgPool) -> PgPool:
+        self.pools[pool.pool_id] = pool
+        return pool
+
+    # -- state queries -----------------------------------------------------
+    def exists(self, osd: int) -> bool:
+        return 0 <= osd < self.max_osd and self.osd_exists[osd]
+
+    def is_up(self, osd: int) -> bool:
+        return self.exists(osd) and self.osd_up[osd]
+
+    def is_down(self, osd: int) -> bool:
+        return not self.is_up(osd)
+
+    def mark_down(self, osd: int) -> None:
+        self.osd_up[osd] = False
+
+    def mark_out(self, osd: int) -> None:
+        self.osd_weight[osd] = 0
+
+    # -- mapping pipeline (scalar oracle) ----------------------------------
+    def _pg_to_raw_osds(self, pool: PgPool, ps: int) -> tuple[list[int], int]:
+        pps = pool.raw_pg_to_pps(ps)
+        ruleno = self.crush.find_rule(pool.crush_rule, pool.type, pool.size)
+        raw: list[int] = []
+        if ruleno >= 0:
+            raw = self.crush.do_rule(ruleno, pps, pool.size, self.osd_weight)
+        self._remove_nonexistent(pool, raw)
+        return raw, pps
+
+    def _remove_nonexistent(self, pool: PgPool, osds: list[int]) -> None:
+        if pool.can_shift_osds():
+            osds[:] = [o for o in osds if self.exists(o)]
+        else:
+            for i, o in enumerate(osds):
+                if o != CRUSH_ITEM_NONE and not self.exists(o):
+                    osds[i] = CRUSH_ITEM_NONE
+
+    def _apply_upmap(self, pool: PgPool, ps: int, raw: list[int]) -> list[int]:
+        pg = (pool.pool_id, pool.raw_pg_to_pg_seed(ps))
+        explicit = self.pg_upmap.get(pg)
+        if explicit:
+            if not any(
+                o != CRUSH_ITEM_NONE
+                and 0 <= o < self.max_osd
+                and self.osd_weight[o] == 0
+                for o in explicit
+            ):
+                raw = list(explicit)
+        items = self.pg_upmap_items.get(pg)
+        if items:
+            for src, dst in items:
+                pos = -1
+                exists = False
+                for i, o in enumerate(raw):
+                    if o == dst:
+                        exists = True
+                        break
+                    if o == src and pos < 0:
+                        dst_out = (
+                            dst != CRUSH_ITEM_NONE
+                            and 0 <= dst < self.max_osd
+                            and self.osd_weight[dst] == 0
+                        )
+                        if not dst_out:
+                            pos = i
+                if not exists and pos >= 0:
+                    raw[pos] = dst
+        return raw
+
+    def _raw_to_up_osds(self, pool: PgPool, raw: list[int]) -> list[int]:
+        if pool.can_shift_osds():
+            return [o for o in raw if self.is_up(o)]
+        return [
+            o if o != CRUSH_ITEM_NONE and self.is_up(o) else CRUSH_ITEM_NONE
+            for o in raw
+        ]
+
+    @staticmethod
+    def _pick_primary(osds: list[int]) -> int:
+        for o in osds:
+            if o != CRUSH_ITEM_NONE:
+                return o
+        return -1
+
+    def _apply_primary_affinity(
+        self, pps: int, pool: PgPool, osds: list[int], primary: int
+    ) -> tuple[list[int], int]:
+        aff = self.osd_primary_affinity
+        if aff is None:
+            return osds, primary
+        if not any(
+            o != CRUSH_ITEM_NONE
+            and aff[o] != CEPH_OSD_DEFAULT_PRIMARY_AFFINITY
+            for o in osds
+        ):
+            return osds, primary
+        pos = -1
+        for i, o in enumerate(osds):
+            if o == CRUSH_ITEM_NONE:
+                continue
+            a = aff[o]
+            if (
+                a < CEPH_OSD_MAX_PRIMARY_AFFINITY
+                and (crush_hash32_2(pps, o) >> 16) >= a
+            ):
+                if pos < 0:
+                    pos = i  # fallback, keep looking
+            else:
+                pos = i
+                break
+        if pos < 0:
+            return osds, primary
+        primary = osds[pos]
+        if pool.can_shift_osds() and pos > 0:
+            osds = [osds[pos]] + osds[:pos] + osds[pos + 1 :]
+        return osds, primary
+
+    def _get_temp_osds(
+        self, pool: PgPool, ps: int
+    ) -> tuple[list[int], int]:
+        pg = (pool.pool_id, pool.raw_pg_to_pg_seed(ps))
+        temp_pg: list[int] = []
+        for o in self.pg_temp.get(pg, []):
+            if not self.is_up(o):
+                if pool.can_shift_osds():
+                    continue
+                temp_pg.append(CRUSH_ITEM_NONE)
+            else:
+                temp_pg.append(o)
+        temp_primary = self.primary_temp.get(pg, -1)
+        if temp_primary == -1 and temp_pg:
+            temp_primary = self._pick_primary(temp_pg)
+        return temp_pg, temp_primary
+
+    def pg_to_up_acting_osds(
+        self, pool_id: int, ps: int
+    ) -> tuple[list[int], int, list[int], int]:
+        """(up, up_primary, acting, acting_primary) — OSDMap.cc:2668."""
+        pool = self.pools.get(pool_id)
+        if pool is None or ps >= pool.pg_num:
+            return [], -1, [], -1
+        acting, acting_primary = self._get_temp_osds(pool, ps)
+        raw, pps = self._pg_to_raw_osds(pool, ps)
+        raw = self._apply_upmap(pool, ps, raw)
+        up = self._raw_to_up_osds(pool, raw)
+        up_primary = self._pick_primary(up)
+        up, up_primary = self._apply_primary_affinity(
+            pps, pool, up, up_primary
+        )
+        if not acting:
+            acting = list(up)
+            if acting_primary == -1:
+                acting_primary = up_primary
+        return up, up_primary, acting, acting_primary

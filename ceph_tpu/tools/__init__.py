@@ -1,0 +1,2 @@
+"""CLI tools mirroring the reference harnesses (crushtool, osdmaptool,
+ceph_erasure_code_benchmark) flag-for-flag where it matters."""

@@ -1,0 +1,184 @@
+"""crushtool --test equivalent (src/tools/crushtool.cc:200-231,535 and
+src/crush/CrushTester.{h,cc}).
+
+Maps x ∈ [min-x, max-x) through a rule and reports utilization,
+chi-squared uniformity and bad mappings — plus mappings/sec, which is
+the PG-mapping benchmark surface (BASELINE.md).  Instead of compiled
+crushmap files the map comes from a synthetic hierarchy spec
+(``--build``) mirroring crushtool's --build mode.
+
+Backends: ``jax`` (batched device kernel) or ``oracle`` (exact scalar).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+from ..crush.builder import CrushMap
+from ..crush.types import (
+    CRUSH_BUCKET_STRAW2,
+    CRUSH_ITEM_NONE,
+    Tunables,
+)
+
+
+def build_hierarchy(
+    num_osds: int,
+    per_host: int,
+    hosts_per_rack: int = 0,
+    weight_fn=None,
+) -> CrushMap:
+    """root -> [racks ->] hosts -> osds, all straw2 (the benchmark
+    hierarchy: 10k OSDs via --build's layered buckets)."""
+    m = CrushMap(tunables=Tunables())
+    weight_fn = weight_fn or (lambda osd: 0x10000)
+    hosts = []
+    for h in range((num_osds + per_host - 1) // per_host):
+        items = list(range(h * per_host, min((h + 1) * per_host, num_osds)))
+        if not items:
+            break
+        weights = [weight_fn(i) for i in items]
+        hosts.append(
+            m.add_bucket(CRUSH_BUCKET_STRAW2, 1, items, weights,
+                         name=f"host{h}")
+        )
+    level = hosts
+    if hosts_per_rack:
+        racks = []
+        for r in range((len(hosts) + hosts_per_rack - 1) // hosts_per_rack):
+            sub = hosts[r * hosts_per_rack : (r + 1) * hosts_per_rack]
+            racks.append(
+                m.add_bucket(
+                    CRUSH_BUCKET_STRAW2,
+                    2,
+                    sub,
+                    [m.buckets[b].weight for b in sub],
+                    name=f"rack{r}",
+                )
+            )
+        level = racks
+    m.add_bucket(
+        CRUSH_BUCKET_STRAW2,
+        3,
+        level,
+        [m.buckets[b].weight for b in level],
+        name="default",
+    )
+    m.add_simple_rule("replicated_rule", "default", "host", mode="firstn")
+    m.add_simple_rule("ec_rule", "default", "host", mode="indep")
+    return m
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="crushtool", description=__doc__)
+    p.add_argument("--test", action="store_true", required=True)
+    p.add_argument("--build", metavar="OSDS:PER_HOST[:HOSTS_PER_RACK]",
+                   default="64:4",
+                   help="synthesize a straw2 hierarchy")
+    p.add_argument("--min-x", type=int, default=0)
+    p.add_argument("--max-x", type=int, default=1024)
+    p.add_argument("--num-rep", type=int, default=3)
+    p.add_argument("--rule", type=int, default=0)
+    p.add_argument("--backend", default="jax", choices=["jax", "oracle"])
+    p.add_argument("--show-utilization", action="store_true")
+    p.add_argument("--show-statistics", action="store_true")
+    p.add_argument("--show-bad-mappings", action="store_true")
+    p.add_argument("--weight", type=float, action="append", default=[],
+                   metavar="OSD:W", help="reweight osd, e.g. 3:0.5")
+    return p.parse_args(argv)
+
+
+def run_test(m: CrushMap, args) -> dict:
+    n = args.max_x - args.min_x
+    xs = np.arange(args.min_x, args.max_x, dtype=np.int64)
+    num_osds = m.max_devices
+    weights = [0x10000] * num_osds
+    for spec in args.weight:
+        osd, w = str(spec).split(":") if isinstance(spec, str) else (None, None)
+        weights[int(osd)] = int(float(w) * 0x10000)
+
+    t0 = time.perf_counter()
+    if args.backend == "jax":
+        from ..crush import jaxmap
+
+        cm = jaxmap.compile_map(m)
+        res, counts = jaxmap.batch_do_rule(
+            cm, args.rule, xs, args.num_rep, weights
+        )
+        res = np.asarray(res)
+        counts = np.asarray(counts)
+        # time a second, compile-free pass for the throughput figure
+        t0 = time.perf_counter()
+        res2, _ = jaxmap.batch_do_rule(
+            cm, args.rule, xs, args.num_rep, weights
+        )
+        np.asarray(res2)
+        elapsed = time.perf_counter() - t0
+    else:
+        rows = []
+        counts = []
+        for x in xs:
+            r = m.do_rule(args.rule, int(x), args.num_rep, weights)
+            counts.append(len(r))
+            rows.append(r + [CRUSH_ITEM_NONE] * (args.num_rep - len(r)))
+        res = np.asarray(rows, dtype=np.int64)
+        counts = np.asarray(counts)
+        elapsed = time.perf_counter() - t0
+
+    valid = (res != CRUSH_ITEM_NONE) & (
+        np.arange(args.num_rep)[None, :] < counts[:, None]
+    )
+    per_osd = np.bincount(
+        res[valid].astype(np.int64), minlength=num_osds
+    )
+    bad = int((counts < args.num_rep).sum())
+    total = int(valid.sum())
+    expected = total / num_osds if num_osds else 0.0
+    chi2 = (
+        float((((per_osd - expected) ** 2) / expected).sum())
+        if expected
+        else 0.0
+    )
+    return {
+        "n": n,
+        "elapsed": elapsed,
+        "mappings_per_sec": n / elapsed if elapsed else float("inf"),
+        "per_osd": per_osd,
+        "bad": bad,
+        "chi2": chi2,
+        "expected": expected,
+    }
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    parts = [int(v) for v in args.build.split(":")]
+    num_osds, per_host = parts[0], parts[1]
+    hpr = parts[2] if len(parts) > 2 else 0
+    m = build_hierarchy(num_osds, per_host, hpr)
+    stats = run_test(m, args)
+    print(
+        f"rule {args.rule} x [{args.min_x},{args.max_x}) num_rep "
+        f"{args.num_rep}: {stats['n']} mappings in "
+        f"{stats['elapsed']:.4f}s = {stats['mappings_per_sec']:.0f} "
+        f"mappings/sec [{args.backend}]"
+    )
+    if args.show_bad_mappings or stats["bad"]:
+        print(f"bad mappings (short of {args.num_rep}): {stats['bad']}")
+    if args.show_utilization:
+        for osd, cnt in enumerate(stats["per_osd"]):
+            print(f"  device {osd}:\t{cnt}")
+    if args.show_statistics:
+        print(
+            f"chi-squared = {stats['chi2']:.2f} "
+            f"(expected per device {stats['expected']:.1f})"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

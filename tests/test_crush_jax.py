@@ -1,0 +1,204 @@
+"""Device-kernel CRUSH vs the exact oracle.
+
+The oracle itself is golden-verified against the reference C
+(test_crush.py), so oracle parity here is transitive C parity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.builder import CrushMap
+from ceph_tpu.crush.jaxmap import (
+    UnsupportedMap,
+    batch_do_rule,
+    compile_map,
+)
+from ceph_tpu.crush.types import (
+    CRUSH_BUCKET_STRAW,
+    CRUSH_BUCKET_STRAW2,
+    CRUSH_ITEM_NONE,
+    Rule,
+    RuleStep,
+    Tunables,
+    CRUSH_RULE_CHOOSELEAF_FIRSTN,
+    CRUSH_RULE_CHOOSELEAF_INDEP,
+    CRUSH_RULE_CHOOSE_FIRSTN,
+    CRUSH_RULE_CHOOSE_INDEP,
+    CRUSH_RULE_EMIT,
+    CRUSH_RULE_SET_CHOOSELEAF_TRIES,
+    CRUSH_RULE_SET_CHOOSE_TRIES,
+    CRUSH_RULE_TAKE,
+)
+
+JEWEL = Tunables(0, 0, 50, 1, 1, 1, 0)
+FIREFLY = Tunables(0, 0, 50, 1, 1, 0, 0)
+
+
+def _add_two_rules(m, root, domain_type):
+    m.add_rule(
+        Rule(
+            steps=[
+                RuleStep(CRUSH_RULE_TAKE, root),
+                RuleStep(
+                    CRUSH_RULE_CHOOSELEAF_FIRSTN
+                    if domain_type
+                    else CRUSH_RULE_CHOOSE_FIRSTN,
+                    0,
+                    domain_type,
+                ),
+                RuleStep(CRUSH_RULE_EMIT),
+            ],
+            type=1,
+        ),
+        0,
+    )
+    m.add_rule(
+        Rule(
+            steps=[
+                RuleStep(CRUSH_RULE_SET_CHOOSELEAF_TRIES, 5),
+                RuleStep(CRUSH_RULE_SET_CHOOSE_TRIES, 100),
+                RuleStep(CRUSH_RULE_TAKE, root),
+                RuleStep(
+                    CRUSH_RULE_CHOOSELEAF_INDEP
+                    if domain_type
+                    else CRUSH_RULE_CHOOSE_INDEP,
+                    0,
+                    domain_type,
+                ),
+                RuleStep(CRUSH_RULE_EMIT),
+            ],
+            type=3,
+        ),
+        1,
+    )
+
+
+def flat_map(tun=JEWEL):
+    m = CrushMap(tunables=tun)
+    root = m.add_bucket(
+        CRUSH_BUCKET_STRAW2,
+        3,
+        list(range(10)),
+        [(i + 1) * 0x10000 // 2 for i in range(10)],
+    )
+    _add_two_rules(m, root, 0)
+    return m
+
+
+def two_level_map(tun=JEWEL, nhosts=5, per_host=4):
+    m = CrushMap(tunables=tun)
+    hosts = []
+    for h in range(nhosts):
+        items = [h * per_host + i for i in range(per_host)]
+        weights = [0x10000 + ((h * per_host + i) % 5) * 0x4000 for i in range(per_host)]
+        hosts.append(m.add_bucket(CRUSH_BUCKET_STRAW2, 1, items, weights))
+    hw = [m.buckets[b].weight for b in hosts]
+    root = m.add_bucket(CRUSH_BUCKET_STRAW2, 3, hosts, hw)
+    _add_two_rules(m, root, 1)
+    return m
+
+
+def three_level_map(tun=JEWEL):
+    """racks(2) -> hosts(3 each) -> osds(4 each), mixed weights."""
+    m = CrushMap(tunables=tun)
+    racks = []
+    osd = 0
+    rng = np.random.default_rng(7)
+    for r in range(2):
+        hosts = []
+        for h in range(3):
+            items = list(range(osd, osd + 4))
+            osd += 4
+            weights = [int(w) * 0x4000 for w in rng.integers(1, 8, 4)]
+            hosts.append(m.add_bucket(CRUSH_BUCKET_STRAW2, 1, items, weights))
+        hw = [m.buckets[b].weight for b in hosts]
+        racks.append(m.add_bucket(CRUSH_BUCKET_STRAW2, 2, hosts, hw))
+    rw = [m.buckets[b].weight for b in racks]
+    root = m.add_bucket(CRUSH_BUCKET_STRAW2, 3, racks, rw)
+    _add_two_rules(m, root, 1)
+    return m
+
+
+def mixed_weight_vector(n, seed=3):
+    rng = np.random.default_rng(seed)
+    w = np.full(n, 0x10000, dtype=np.int64)
+    out = rng.choice(n, size=max(1, n // 6), replace=False)
+    w[out] = 0
+    half = rng.choice(n, size=max(1, n // 5), replace=False)
+    w[half] = 0x8000
+    return w
+
+
+@pytest.mark.parametrize(
+    "mkmap",
+    [flat_map, two_level_map, three_level_map],
+    ids=["flat", "two_level", "three_level"],
+)
+@pytest.mark.parametrize("rule", [0, 1], ids=["firstn", "indep"])
+def test_device_matches_oracle(mkmap, rule):
+    m = mkmap()
+    cm = compile_map(m)
+    n = 256
+    xs = np.arange(n, dtype=np.int32)
+    for result_max in (1, 3, 5):
+        for weights in (
+            [0x10000] * m.max_devices,
+            list(mixed_weight_vector(m.max_devices)),
+        ):
+            got, counts = batch_do_rule(cm, rule, xs, result_max, weights)
+            got = np.asarray(got)
+            counts = np.asarray(counts)
+            for x in range(n):
+                expect = m.do_rule(rule, x, result_max, list(weights))
+                gx = got[x, : counts[x]].tolist()
+                assert gx == expect, (
+                    mkmap.__name__,
+                    rule,
+                    result_max,
+                    x,
+                    gx,
+                    expect,
+                )
+
+
+def test_firefly_stable0_matches_oracle():
+    m = two_level_map(tun=FIREFLY)
+    cm = compile_map(m)
+    xs = np.arange(128, dtype=np.int32)
+    got, counts = batch_do_rule(cm, 0, xs, 3)
+    for x in range(128):
+        expect = m.do_rule(0, x, 3)
+        assert np.asarray(got)[x, : counts[x]].tolist() == expect
+
+
+def test_unsupported_fallback():
+    m = CrushMap(tunables=JEWEL)
+    root = m.add_bucket(
+        CRUSH_BUCKET_STRAW, 3, [0, 1, 2], [0x10000] * 3
+    )
+    _add_two_rules(m, root, 0)
+    with pytest.raises(UnsupportedMap):
+        compile_map(m)
+
+
+def test_large_hierarchy_spot_check():
+    """200-OSD straw2 tree; spot-check 32 xs against the oracle."""
+    m = CrushMap(tunables=JEWEL)
+    hosts = []
+    for h in range(20):
+        items = list(range(h * 10, h * 10 + 10))
+        weights = [0x10000 + (i % 7) * 0x2000 for i in items]
+        hosts.append(m.add_bucket(CRUSH_BUCKET_STRAW2, 1, items, weights))
+    hw = [m.buckets[b].weight for b in hosts]
+    root = m.add_bucket(CRUSH_BUCKET_STRAW2, 3, hosts, hw)
+    _add_two_rules(m, root, 1)
+    cm = compile_map(m)
+    xs = np.arange(0, 64000, 2000, dtype=np.int32)
+    wv = mixed_weight_vector(m.max_devices, seed=11)
+    for rule in (0, 1):
+        got, counts = batch_do_rule(cm, rule, xs, 4, wv)
+        for i, x in enumerate(xs):
+            expect = m.do_rule(rule, int(x), 4, list(wv))
+            assert np.asarray(got)[i, : counts[i]].tolist() == expect

@@ -1,0 +1,141 @@
+"""OSDMap mapping pipeline: batched device path vs scalar oracle.
+
+The scalar oracle implements OSDMap.cc:2668's pipeline stage by stage;
+the batched OSDMapMapping must agree PG-for-PG under every override
+mechanism (upmap, upmap_items, pg_temp, primary_temp, affinity, down /
+out / nonexistent OSDs) for both replicated and EC pools.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.builder import CrushMap
+from ceph_tpu.crush.types import (
+    CRUSH_BUCKET_STRAW2,
+    CRUSH_ITEM_NONE,
+    PG_POOL_TYPE_ERASURE,
+    PG_POOL_TYPE_REPLICATED,
+    Tunables,
+)
+from ceph_tpu.osd import OSDMap, OSDMapMapping, PgPool
+
+JEWEL = Tunables(0, 0, 50, 1, 1, 1, 0)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    m = CrushMap(tunables=JEWEL)
+    hosts = []
+    for h in range(6):
+        items = list(range(h * 4, h * 4 + 4))
+        weights = [0x10000 + (i % 3) * 0x8000 for i in items]
+        hosts.append(
+            m.add_bucket(CRUSH_BUCKET_STRAW2, 1, items, weights, name=f"h{h}")
+        )
+    root = m.add_bucket(
+        CRUSH_BUCKET_STRAW2,
+        3,
+        hosts,
+        [m.buckets[b].weight for b in hosts],
+        name="default",
+    )
+    rep = m.add_simple_rule("rep", "default", "host", mode="firstn")
+    ec = m.add_simple_rule("ecr", "default", "host", mode="indep")
+
+    om = OSDMap.build(m, 24)
+    om.add_pool(
+        PgPool(pool_id=1, type=PG_POOL_TYPE_REPLICATED, size=3,
+               pg_num=48, crush_rule=rep)
+    )
+    om.add_pool(
+        PgPool(pool_id=2, type=PG_POOL_TYPE_ERASURE, size=5,
+               pg_num=27, crush_rule=ec)  # pg_num not a power of two
+    )
+    # state variety
+    om.mark_down(5)
+    om.mark_down(13)
+    om.osd_exists[17] = False
+    om.mark_out(9)
+    om.osd_weight[2] = 0x8000
+    # overrides
+    om.pg_upmap[(1, 3)] = [0, 4, 8]
+    om.pg_upmap[(2, 4)] = [0, 4, 8, 12, 16]
+    om.pg_upmap_items[(1, 7)] = [(0, 20), (4, 21)]
+    om.pg_upmap_items[(2, 11)] = [(8, 22)]
+    om.pg_temp[(1, 5)] = [10, 11, 12]
+    om.pg_temp[(2, 6)] = [1, 2, 3, 4, 6]
+    om.primary_temp[(1, 9)] = 15
+    om.osd_primary_affinity = [0x10000] * 24
+    om.osd_primary_affinity[0] = 0
+    om.osd_primary_affinity[4] = 0x4000
+    om.osd_primary_affinity[8] = 0x8000
+    return om
+
+
+def _norm(v):
+    v = list(v)
+    while v and v[-1] == CRUSH_ITEM_NONE:
+        v.pop()
+    return v
+
+
+@pytest.mark.parametrize("use_device", [False, True], ids=["numpy", "jax"])
+def test_batched_matches_scalar(cluster, use_device):
+    om = cluster
+    mapping = OSDMapMapping()
+    mapping.update(om, use_device=use_device)
+    for pool_id, pool in om.pools.items():
+        for ps in range(pool.pg_num):
+            up, upp, acting, actp = om.pg_to_up_acting_osds(pool_id, ps)
+            gup, gupp, gact, gactp = mapping.get(pool_id, ps)
+            assert _norm(gup) == _norm(up), (pool_id, ps)
+            assert gupp == upp, (pool_id, ps)
+            assert _norm(gact) == _norm(acting), (pool_id, ps)
+            assert gactp == actp, (pool_id, ps)
+
+
+def test_pipeline_properties(cluster):
+    om = cluster
+    # down osd never in up set; out osd never chosen by crush
+    for ps in range(48):
+        up, upp, acting, actp = om.pg_to_up_acting_osds(1, ps)
+        assert 5 not in up and 13 not in up and 17 not in up
+        assert 9 not in up
+        if up:
+            assert upp == up[0] or om.osd_primary_affinity is not None
+    # EC keeps positional holes
+    up, _, _, _ = om.pg_to_up_acting_osds(2, 6)
+    assert len(up) <= 5
+    # pg_temp overrides acting but not up
+    up, upp, acting, actp = om.pg_to_up_acting_osds(1, 5)
+    assert acting == [10, 11, 12]
+    assert actp == 10
+    assert up != acting or up == [10, 11, 12]
+    # primary_temp overrides acting primary only
+    _, upp9, _, actp9 = om.pg_to_up_acting_osds(1, 9)
+    assert actp9 == 15
+    # explicit upmap applies (targets all in+up); affinity may rotate
+    # the primary to the front afterwards
+    up3, upp3, _, _ = om.pg_to_up_acting_osds(1, 3)
+    assert sorted(up3) == [0, 4, 8]
+    assert upp3 == up3[0]
+
+
+def test_upmap_rejected_when_target_out(cluster):
+    om = cluster
+    om.pg_upmap[(1, 20)] = [9, 0, 4]  # osd.9 is out (weight 0)
+    up, _, _, _ = om.pg_to_up_acting_osds(1, 20)
+    assert up != [9, 0, 4]
+    del om.pg_upmap[(1, 20)]
+
+
+def test_affinity_zero_never_primary_unless_sole(cluster):
+    om = cluster
+    count0 = 0
+    for ps in range(48):
+        up, upp, _, _ = om.pg_to_up_acting_osds(1, ps)
+        if upp == 0 and len(up) > 1:
+            count0 += 1
+    assert count0 == 0  # affinity 0 ⇒ rejected whenever alternatives exist
