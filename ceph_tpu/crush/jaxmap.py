@@ -54,10 +54,6 @@ from .types import (  # noqa: E402
 
 MAX_DEPTH = 16  # CRUSH_MAX_DEPTH is 10; headroom is free in a fori
 
-# descend status codes
-_FOUND, _EMPTY, _BAD = 0, 1, 2
-
-
 class UnsupportedMap(ValueError):
     """Map/rule shape outside the device kernel's scope; use the oracle."""
 
@@ -87,55 +83,35 @@ def _hash2(a, b):
     return h.astype(jnp.uint32)
 
 
-@functools.lru_cache(maxsize=1)
-def _ln_consts():
-    # plain numpy int64 — jnp would cache trace-scoped tracers here
-    rh, lh, ll = _ln_tables()
-    return rh, lh, ll
-
-
-def _crush_ln(u):
-    """2^44*log2(u+1) in fixed point (mapper.c:248-290), u uint32."""
-    rh, lh, ll = _ln_consts()
-    rh_tbl = jnp.asarray(rh, dtype=jnp.int64)
-    lh_tbl = jnp.asarray(lh, dtype=jnp.int64)
-    ll_tbl = jnp.asarray(ll, dtype=jnp.int64)
-    x = u.astype(jnp.int64) + 1
-    masked = x & 0x1FFFF
-    nbits = jnp.zeros_like(x)
-    for shift in (16, 8, 4, 2, 1):
-        step = (masked >> shift) != 0
-        nbits = nbits + jnp.where(step, shift, 0)
-        masked = jnp.where(step, masked >> shift, masked)
-    bitlen = nbits + (masked != 0)
-    shift_amt = jnp.where((x & 0x18000) == 0, 16 - bitlen, 0)
-    x = x << shift_amt
-    iexpon = 15 - shift_amt
-    k = ((x >> 8) << 1) - 256 >> 1
-    # x*RH reaches 2^63; like the C, only the wrapped low bits feed index2
-    xl64 = (x * rh_tbl[k]) >> 48
-    index2 = xl64 & 0xFF
-    return (iexpon << 44) + ((lh_tbl[k] + ll_tbl[index2]) >> 4)
-
-
 # -- map compilation -------------------------------------------------------
 
 
 @dataclass(frozen=True)
 class CompiledMap:
-    """Dense-array rendering of a CrushMap for the device kernel."""
+    """Dense-array rendering of a CrushMap for the device kernel.
 
-    items: jnp.ndarray  # (nb, sz) int32 — bucket members (neg = bucket)
-    weights: jnp.ndarray  # (nb, sz) int64 — 16.16 straw2 weights
-    sizes: jnp.ndarray  # (nb,) int32
-    types: jnp.ndarray  # (nb,) int32
-    bidx: jnp.ndarray  # (max_neg,) int32 — (-1-id) -> bucket row, -1 gap
+    All hot-path tables are float32, consumed through one-hot matmuls:
+    dynamic gathers are pathologically slow on TPU (measured ~20 ns per
+    gathered element vs ~1 ns through the MXU), and every value fits a
+    float32 mantissa exactly after the 24-bit splits below, so lookups
+    stay bit-exact.  Downstream arithmetic runs in float64 whose
+    integer range (2^53) covers the 2^48 fixed-point ln values.
+    """
+
+    row_pack: jnp.ndarray  # (nb, 3*sz+1) f32: items | w_hi | w_lo | size
+    types_f: jnp.ndarray  # (nb,) f32 bucket types
+    bidx_f: jnp.ndarray  # (max_neg,) f32: (-1-id) -> row, -1 for gaps
+    ln_tbl1: jnp.ndarray  # (129, 4) f32: rh_hi, rh_lo, lh_hi, lh_lo
+    ln_tbl2: jnp.ndarray  # (256, 2) f32: ll_hi, ll_lo
+    sz: int
+    nb: int
+    bidx: tuple  # host-side (-1-id) -> row for TAKE resolution
     max_devices: int
     tunables: tuple  # (total_tries, descend_once, vary_r, stable)
     rules: tuple  # immutable rule description for cache keys
 
     def __hash__(self):
-        return hash((id(self.items), self.rules, self.tunables))
+        return hash((id(self.row_pack), self.rules, self.tunables))
 
     def __eq__(self, other):
         return self is other
@@ -160,31 +136,55 @@ def compile_map(cmap) -> CompiledMap:
         raise UnsupportedMap("choose_args not yet in the device kernel")
 
     nb = len(cmap.buckets)
-    sz = max(b.size for b in cmap.buckets.values())
-    sz = max(sz, 1)
-    items = np.zeros((nb, sz), dtype=np.int32)
+    sz = max(max(b.size for b in cmap.buckets.values()), 1)
+    items = np.zeros((nb, sz), dtype=np.int64)
     weights = np.zeros((nb, sz), dtype=np.int64)
-    sizes = np.zeros(nb, dtype=np.int32)
-    types = np.zeros(nb, dtype=np.int32)
+    sizes = np.zeros(nb, dtype=np.int64)
+    types = np.zeros(nb, dtype=np.int64)
     max_neg = max(-b.id for b in cmap.buckets.values())
-    bidx = np.full(max_neg, -1, dtype=np.int32)
-    for row, b in enumerate(sorted(cmap.buckets.values(), key=lambda b: -b.id)):
+    bidx = np.full(max_neg, -1, dtype=np.int64)
+    for row, b in enumerate(
+        sorted(cmap.buckets.values(), key=lambda b: -b.id)
+    ):
         items[row, : b.size] = b.items
         weights[row, : b.size] = b.item_weights
         sizes[row] = b.size
         types[row] = b.type
         bidx[-1 - b.id] = row
+        if b.size and max(abs(i) for i in b.items) >= 1 << 24:
+            raise UnsupportedMap("item id magnitude >= 2^24")
+        if b.weight >= 1 << 32:
+            raise UnsupportedMap("bucket weight >= 2^32")
 
     rules = []
     for rule in cmap.rules:
         rules.append(None if rule is None else _compile_rule(rule))
 
+    row_pack = np.concatenate(
+        [
+            items.astype(np.float32),
+            (weights >> 16).astype(np.float32),
+            (weights & 0xFFFF).astype(np.float32),
+            sizes[:, None].astype(np.float32),
+        ],
+        axis=1,
+    )
+    rh, lh, ll = _ln_tables()
+    ln_tbl1 = np.stack(
+        [rh >> 24, rh & 0xFFFFFF, lh >> 24, lh & 0xFFFFFF], axis=1
+    ).astype(np.float32)
+    ln_tbl2 = np.stack([ll >> 24, ll & 0xFFFFFF], axis=1).astype(
+        np.float32
+    )
     return CompiledMap(
-        items=jnp.asarray(items),
-        weights=jnp.asarray(weights),
-        sizes=jnp.asarray(sizes),
-        types=jnp.asarray(types),
-        bidx=jnp.asarray(bidx),
+        row_pack=jnp.asarray(row_pack),
+        types_f=jnp.asarray(types.astype(np.float32)),
+        bidx_f=jnp.asarray(bidx.astype(np.float32)),
+        ln_tbl1=jnp.asarray(ln_tbl1),
+        ln_tbl2=jnp.asarray(ln_tbl2),
+        sz=sz,
+        nb=nb,
+        bidx=tuple(int(v) for v in bidx),
         max_devices=cmap.max_devices,
         tunables=(
             t.choose_total_tries + 1,
@@ -219,7 +219,16 @@ def _compile_rule(rule):
                 if step.arg1 > 0:
                     raise UnsupportedMap("local tries override")
                 continue
-            overrides[step.op] = step.arg1
+            # the C applies tries overrides only when > 0 and
+            # vary_r/stable only when >= 0 (mapper.c:963-991)
+            if step.op in (
+                CRUSH_RULE_SET_CHOOSE_TRIES,
+                CRUSH_RULE_SET_CHOOSELEAF_TRIES,
+            ):
+                if step.arg1 > 0:
+                    overrides[step.op] = step.arg1
+            elif step.arg1 >= 0:
+                overrides[step.op] = step.arg1
         elif step.op == CRUSH_RULE_TAKE:
             take = step.arg1
         elif step.op in (
@@ -249,264 +258,415 @@ def _compile_rule(rule):
 
 
 def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
-    """Build the scalar-traced do_rule for one (map, rule, result_max)."""
+    """Build the scalar-traced do_rule for one (map, rule, result_max).
+
+    Each chooser is ONE flat while_loop whose every iteration performs
+    exactly one straw2 bucket draw; descent levels, retry-descents and
+    chooseleaf recursion are a mode register, not nested loops.  Under
+    vmap all lanes advance together, so wall-clock per batch is the
+    *maximum lane's total draw count* (typically depth+1 draws per
+    replica plus a few retries) instead of the product of worst-case
+    iteration counts at three nesting levels that a literal translation
+    pays."""
     groups = cm.rules[ruleno]
     if groups is None:
         raise UnsupportedMap(f"no rule {ruleno}")
     total_tries, descend_once, vary_r_t, stable_t = cm.tunables
     NONE = jnp.int32(CRUSH_ITEM_NONE)
     UNDEF = jnp.int32(CRUSH_ITEM_UNDEF)
-    S64_MIN = jnp.int64(-(1 << 63))
+    OUTER, LEAF = jnp.int32(0), jnp.int32(1)
+
+    HIP = jax.lax.Precision.HIGHEST
+    SZ, NB = cm.sz, cm.nb
+    NEGB = cm.bidx_f.shape[0]
+
+    def _lookup(i, n, table):
+        """One-hot matmul lookup: table row i (f32-exact), the
+        TPU-native replacement for a dynamic gather."""
+        oh = (jnp.arange(n) == i).astype(jnp.float32)
+        return jnp.matmul(oh, table, precision=HIP)
+
+    def _crush_ln_f64(u):
+        """2^44*log2(u+1) exactly, in float64 (mapper.c:248-290).
+
+        Table halves are < 2^24 so the f32 one-hot matmuls are exact;
+        all arithmetic stays on integers < 2^53.  index2 reproduces
+        ((x*RH) >> 48) & 0xff via the 24-bit split (the C's int64
+        wraparound only ever touches bits that the mod-256 discards).
+        Verified value-exact against the int64 path over the full u16
+        domain."""
+        x = u.astype(jnp.int32) + 1
+        masked = x & 0x1FFFF
+        nbits = jnp.zeros_like(x)
+        for shift in (16, 8, 4, 2, 1):
+            step = (masked >> shift) != 0
+            nbits = nbits + jnp.where(step, shift, 0)
+            masked = jnp.where(step, masked >> shift, masked)
+        bitlen = nbits + (masked != 0)
+        shift_amt = jnp.where((x & 0x18000) == 0, 16 - bitlen, 0)
+        x = x << shift_amt
+        iexp = 15 - shift_amt
+        k = ((x >> 8) << 1) - 256 >> 1
+        oh1 = (jnp.arange(129) == k[:, None]).astype(jnp.float32)
+        t4 = jnp.matmul(oh1, cm.ln_tbl1, precision=HIP).astype(
+            jnp.float64
+        )
+        rh_hi, rh_lo = t4[:, 0], t4[:, 1]
+        lh_v = t4[:, 2] * float(1 << 24) + t4[:, 3]
+        xf = x.astype(jnp.float64)
+        T = xf * rh_hi + jnp.floor(xf * rh_lo / float(1 << 24))
+        index2 = jnp.mod(
+            jnp.floor(T / float(1 << 24)), 256.0
+        ).astype(jnp.int32)
+        oh2 = (jnp.arange(256) == index2[:, None]).astype(jnp.float32)
+        t2 = jnp.matmul(oh2, cm.ln_tbl2, precision=HIP).astype(
+            jnp.float64
+        )
+        ll_v = t2[:, 0] * float(1 << 24) + t2[:, 1]
+        return iexp.astype(jnp.float64) * float(1 << 44) + jnp.floor(
+            (lh_v + ll_v) / 16.0
+        )
 
     def straw2(bidx_row, x, r):
-        """One straw2 draw-argmax (mapper.c:361-384)."""
-        ids = cm.items[bidx_row]
-        ws = cm.weights[bidx_row]
-        slot = jnp.arange(ids.shape[0])
+        """One straw2 draw-argmax (mapper.c:361-384); returns
+        (item, bucket_size).
+
+        draw_i = -floor(L_i/w_i) computed in float64: L < 2^48 and
+        w < 2^32 are f64-exact, the quotient estimate is off by at most
+        one ulp, and a multiply-compare fixup restores the exact floor
+        (q*w <= L < (q+1)*w with q*w < 2^53 exact)."""
+        row = _lookup(bidx_row, NB, cm.row_pack)
+        ids = jnp.round(row[:SZ]).astype(jnp.int32)
+        wf = row[SZ : 2 * SZ].astype(jnp.float64) * 65536.0 + row[
+            2 * SZ : 3 * SZ
+        ].astype(jnp.float64)
+        size = jnp.round(row[3 * SZ]).astype(jnp.int32)
         u = (
             _hash3(
                 jnp.uint32(x),
                 ids.astype(jnp.uint32),
                 jnp.uint32(r),
-            ).astype(jnp.int64)
-            & 0xFFFF
+            )
+            & jnp.uint32(0xFFFF)
         )
-        ln = _crush_ln(u.astype(jnp.uint32)) - jnp.int64(0x1000000000000)
+        L = float(1 << 48) - _crush_ln_f64(u)
+        q0 = jnp.floor(L / jnp.where(wf > 0, wf, 1.0))
+        t = q0 * wf
+        q = (
+            q0
+            + (t + wf <= L).astype(jnp.float64)
+            - (t > L).astype(jnp.float64)
+        )
         draw = jnp.where(
-            ws > 0, -((-ln) // jnp.maximum(ws, 1)), S64_MIN
+            (wf > 0) & (jnp.arange(SZ) < size), -q, -jnp.inf
         )
-        draw = jnp.where(slot < cm.sizes[bidx_row], draw, S64_MIN)
-        return ids[jnp.argmax(draw)]
+        am = jnp.argmax(draw)
+        item = jnp.sum(
+            jnp.where(jnp.arange(SZ) == am, ids, 0)
+        ).astype(jnp.int32)
+        return item, size
 
     def row_of(item):
         """Bucket row for a (negative) item; -1 if invalid."""
         neg = -1 - item
-        ok = (item < 0) & (neg < cm.bidx.shape[0])
-        return jnp.where(ok, cm.bidx[jnp.clip(neg, 0, None)], -1)
+        ok = (item < 0) & (neg < NEGB)
+        row = jnp.round(
+            _lookup(jnp.clip(neg, 0, None), NEGB, cm.bidx_f)
+        ).astype(jnp.int32)
+        return jnp.where(ok, row, -1)
 
-    def descend(start_row, x, r, ttype):
-        """Walk intermediate buckets until an item of ttype
-        (mapper.c firstn/indep inner descent; r is constant per level
-        for straw2).  Returns (item, status)."""
-
-        def body(_, st):
-            cur_row, item, status, done = st
-            empty = cm.sizes[cur_row] == 0
-            nitem = straw2(cur_row, x, r)
-            bad_dev = nitem >= cm.max_devices
-            nrow = row_of(nitem)
-            ntype = jnp.where(nitem >= 0, 0, cm.types[jnp.maximum(nrow, 0)])
-            invalid = (nitem < 0) & (nrow < 0)
-            found = (~empty) & (~bad_dev) & (~invalid) & (ntype == ttype)
-            bad = (~empty) & (bad_dev | ((ntype != ttype) & ((nitem >= 0) | invalid)))
-            nstatus = jnp.where(
-                empty,
-                _EMPTY,
-                jnp.where(found, _FOUND, jnp.where(bad, _BAD, status)),
-            )
-            ndone = empty | found | bad
-            keep = done
-            return (
-                jnp.where(keep | ndone, cur_row, nrow),
-                jnp.where(keep, item, nitem),
-                jnp.where(keep, status, nstatus),
-                keep | ndone,
-            )
-
-        init = (start_row, jnp.int32(0), jnp.int32(_BAD), jnp.bool_(False))
-        _, item, status, done = lax.fori_loop(0, MAX_DEPTH, body, init)
-        return item, jnp.where(done, status, _BAD)
+    def type_of_row(nrow):
+        return jnp.round(
+            _lookup(jnp.maximum(nrow, 0), NB, cm.types_f)
+        ).astype(jnp.int32)
 
     def is_out(weightv, item, x):
         """mapper.c:424-438 over the device reweight vector."""
         w = weightv[jnp.clip(item, 0, weightv.shape[0] - 1)]
         oob = item >= weightv.shape[0]
         hashed = (
-            _hash2(jnp.uint32(x), jnp.uint32(item)).astype(jnp.int64)
+            _hash2(jnp.uint32(x), jnp.uint32(item)).astype(jnp.int32)
             & 0xFFFF
         )
         return oob | (w == 0) | ((w < 0x10000) & (hashed >= w))
 
-    def leaf_firstn(domain_item, x, sub_r, out2, outpos, weightv, tries, stable):
-        """Inner chooseleaf: one leaf under domain_item (the recursive
-        crush_choose_firstn with numrep=1/outpos+1, type=0)."""
-        rep = jnp.where(stable, 0, outpos)
-        drow = row_of(domain_item)
+    def classify(item, target_type):
+        """(found, descend, hard_bad, nrow) for a drawn item against
+        the level's target type (the firstn/indep descent checks)."""
+        nrow = row_of(item)
+        is_dev = item >= 0
+        invalid = (~is_dev) & (nrow < 0)
+        bad_dev = item >= cm.max_devices
+        itype = jnp.where(is_dev, 0, type_of_row(nrow))
+        found = (~bad_dev) & (~invalid) & (itype == target_type)
+        hard_bad = bad_dev | invalid | (is_dev & (itype != target_type))
+        descend = (~found) & (~hard_bad)
+        return found, descend, hard_bad, nrow
+
+    def choose_firstn(
+        take_row, x, numrep, nslots, ttype, leaf, weightv,
+        tries, leaf_tries, vary_r, stable,
+    ):
+        """crush_choose_firstn (mapper.c:460-648) as a state machine.
+
+        Registers: rep/outpos/ftotal track the C loop variables; mode
+        switches between the outer descent (toward ttype) and the
+        chooseleaf descent (toward a device under ``domain``); every
+        reject path advances r' exactly as the C does.  Exception to
+        one-draw-per-iteration: empty-bucket and depth-exceeded
+        transitions consume an iteration without using the draw.
+
+        ``numrep`` is the C loop bound (reps keep advancing past
+        skipped replicas); ``nslots`` is the count bound on actual
+        placements (the C's out_size/count)."""
+        R = nslots
 
         def cond(st):
-            ftotal, _, placed, skip = st
-            return (~placed) & (~skip)
+            return ~st[0]
 
         def body(st):
-            ftotal, _, _, _ = st
-            r = rep + sub_r + ftotal
-            item, status = descend(drow, x, r, 0)
-            ok = status == _FOUND
-            collide = jnp.any(
-                (jnp.arange(out2.shape[0]) < outpos) & (out2 == item)
-            )
-            rejected = ok & (collide | is_out(weightv, item, x))
-            placed = ok & (~rejected)
-            # EMPTY and reject both advance ftotal; BAD skips the rep
-            skip = (status == _BAD) | (
-                (~placed) & (ftotal + 1 >= tries)
-            )
-            return (ftotal + 1, item, placed, skip)
+            (done, rep, outpos, ftotal, mode, cur_row, domain, lftotal,
+             depth, out, out2) = st
+            in_leaf = mode == LEAF
+            leaf_rep = jnp.int32(0) if stable else outpos
+            r_outer = rep + ftotal
+            if vary_r:
+                sub_r = r_outer >> (vary_r - 1)
+            else:
+                sub_r = jnp.int32(0)
+            r = jnp.where(in_leaf, leaf_rep + sub_r + lftotal, r_outer)
 
-        _, item, placed, _ = lax.while_loop(
-            cond, body, (jnp.int32(0), jnp.int32(0), jnp.bool_(False), jnp.bool_(False))
+            item, bsize = straw2(cur_row, x, r)
+            empty = bsize == 0
+            target = jnp.where(in_leaf, 0, ttype)
+            found, desc, hard_bad, nrow = classify(item, target)
+            # depth guard: runaway descent behaves like a bad item
+            too_deep = desc & (depth + 1 >= MAX_DEPTH)
+            hard_bad = (~empty) & (hard_bad | too_deep)
+            desc = (~empty) & desc & ~too_deep
+            found = (~empty) & found
+
+            o = ~in_leaf
+            o_desc = o & desc
+            o_bad = o & hard_bad
+            o_found = o & found
+            collide = o_found & jnp.any(
+                (jnp.arange(R) < outpos) & (out == item)
+            )
+            if leaf:
+                enter_leaf = o_found & ~collide & (item < 0)
+                direct = o_found & ~collide & (item >= 0)
+            else:
+                enter_leaf = jnp.bool_(False)
+                direct = o_found & ~collide
+            if ttype == 0:
+                direct_out = direct & is_out(weightv, item, x)
+            else:
+                direct_out = jnp.bool_(False)
+            place_direct = direct & ~direct_out
+
+            l = in_leaf
+            l_desc = l & desc
+            l_bad = l & hard_bad
+            l_found = l & found
+            l_rej = l_found & (
+                jnp.any((jnp.arange(R) < outpos) & (out2 == item))
+                | is_out(weightv, item, x)
+            )
+            l_place = l_found & ~l_rej
+            l_retry_cand = (l & empty) | l_rej
+            l_exhaust = l_retry_cand & (lftotal + 1 >= leaf_tries)
+            l_retry = l_retry_cand & ~l_exhaust
+
+            outer_reject = (o & empty) | collide | direct_out | l_bad | l_exhaust
+            or_skip = outer_reject & (ftotal + 1 >= tries)
+            or_retry = outer_reject & ~or_skip
+
+            place = place_direct | l_place
+            skip = o_bad | or_skip
+            advance = place | skip
+
+            sel = place & (jnp.arange(R) == outpos)
+            out = jnp.where(sel, jnp.where(l_place, domain, item), out)
+            if leaf:
+                out2 = jnp.where(sel, item, out2)
+
+            new_rep = rep + advance
+            new_outpos_i = outpos + place
+            new_done = done | (new_rep >= numrep) | (
+                new_outpos_i >= nslots
+            )
+            new_outpos = new_outpos_i
+            new_ftotal = jnp.where(
+                advance, 0, jnp.where(or_retry, ftotal + 1, ftotal)
+            )
+            new_lftotal = jnp.where(
+                enter_leaf, 0, jnp.where(l_retry, lftotal + 1, lftotal)
+            )
+            stay_leaf = enter_leaf | l_desc | l_retry
+            new_mode = jnp.where(stay_leaf, LEAF, OUTER)
+            new_row = jnp.where(
+                o_desc | l_desc | enter_leaf,
+                nrow,
+                jnp.where(l_retry, row_of(domain), take_row),
+            )
+            new_domain = jnp.where(enter_leaf, item, domain)
+            new_depth = jnp.where(o_desc | l_desc, depth + 1, 0)
+            return (
+                new_done, new_rep, new_outpos.astype(jnp.int32),
+                new_ftotal.astype(jnp.int32), new_mode, new_row,
+                new_domain, new_lftotal.astype(jnp.int32),
+                new_depth.astype(jnp.int32), out, out2,
+            )
+
+        init = (
+            jnp.bool_(numrep <= 0 or R == 0), jnp.int32(0), jnp.int32(0),
+            jnp.int32(0),
+            OUTER, jnp.int32(take_row), jnp.int32(0), jnp.int32(0),
+            jnp.int32(0),
+            jnp.full((R,), NONE, dtype=jnp.int32),
+            jnp.full((R,), NONE, dtype=jnp.int32),
         )
-        return item, placed
+        st = lax.while_loop(cond, body, init)
+        outpos = st[2]
+        out, out2 = st[9], st[10]
+        return (out2 if leaf else out), outpos
 
-    def choose_firstn(take_row, x, numrep, ttype, leaf, weightv, tries, leaf_tries, vary_r, stable):
-        """Top-level crush_choose_firstn (outpos=0 frame)."""
-        out = jnp.full((numrep,), NONE, dtype=jnp.int32)
-        out2 = jnp.full((numrep,), NONE, dtype=jnp.int32)
-        outpos = jnp.int32(0)
+    def choose_indep(
+        take_row, x, left0, numrep, ttype, leaf, weightv,
+        tries, leaf_tries,
+    ):
+        """crush_choose_indep (mapper.c:655-843) as a state machine.
 
-        for rep in range(numrep):
+        ``slot`` scans the UNDEF positions of each round; finishing a
+        slot jumps straight to the next UNDEF one, and exhausting them
+        advances the round (ftotal).  r' = slot + n*ftotal at the outer
+        level and slot + r_outer + n*lftotal inside chooseleaf, exactly
+        the C advancement.  ``numrep`` is the unclamped replica count —
+        it sets the r' stride even when left0 < numrep."""
+        R = left0
 
-            def cond(st):
-                ftotal, _, _, placed, skip = st
-                return (~placed) & (~skip)
+        def slot_advance(out, slot, left, ftotal):
+            """Next UNDEF slot after ``slot``; wrap advances the round."""
+            undef = out == UNDEF
+            after = undef & (jnp.arange(R) > slot)
+            has_after = jnp.any(after)
+            nxt = jnp.where(
+                has_after, jnp.argmax(after), jnp.argmax(undef)
+            ).astype(jnp.int32)
+            new_ftotal = ftotal + jnp.where(has_after, 0, 1)
+            done = (left <= 0) | (~jnp.any(undef)) | (new_ftotal >= tries)
+            return nxt, new_ftotal, done
 
-            def body(st, _rep=rep):
-                ftotal, _, _, _, _ = st
-                r = _rep + ftotal
-                item, status = descend(take_row, x, r, ttype)
-                ok = status == _FOUND
-                collide = ok & jnp.any(
-                    (jnp.arange(numrep) < outpos) & (out == item)
-                )
-                reject = jnp.bool_(False)
-                leaf_item = jnp.int32(0)
-                if leaf:
-                    sub_r = (r >> (vary_r - 1)) if vary_r else jnp.int32(0)
-                    is_bucket = item < 0
-                    li, got = leaf_firstn(
-                        jnp.where(is_bucket, item, jnp.int32(-1)),
-                        x,
-                        sub_r,
-                        out2,
-                        outpos,
-                        weightv,
-                        leaf_tries,
-                        stable,
-                    )
-                    leaf_item = jnp.where(is_bucket, li, item)
-                    reject = ok & (~collide) & is_bucket & (~got)
-                if ttype == 0:
-                    reject = reject | (
-                        ok & (~collide) & is_out(weightv, item, x)
-                    )
-                placed = ok & (~collide) & (~reject)
-                skip = (status == _BAD) | (
-                    (~placed) & (ftotal + 1 >= tries)
-                )
-                return (ftotal + 1, item, leaf_item, placed, skip)
+        def cond(st):
+            return ~st[0]
 
-            init = (
-                jnp.int32(0),
-                jnp.int32(0),
-                jnp.int32(0),
-                jnp.bool_(False),
-                jnp.bool_(False),
+        def body(st):
+            (done, slot, left, ftotal, mode, cur_row, domain, lftotal,
+             depth, out, out2) = st
+            in_leaf = mode == LEAF
+            r_outer = slot + numrep * ftotal
+            r = jnp.where(
+                in_leaf, slot + r_outer + numrep * lftotal, r_outer
             )
-            _, item, leaf_item, placed, _ = lax.while_loop(cond, body, init)
+
+            item, bsize = straw2(cur_row, x, r)
+            empty = bsize == 0
+            target = jnp.where(in_leaf, 0, ttype)
+            found, desc, hard_bad, nrow = classify(item, target)
+            too_deep = desc & (depth + 1 >= MAX_DEPTH)
+            hard_bad = (~empty) & (hard_bad | too_deep)
+            desc = (~empty) & desc & ~too_deep
+            found = (~empty) & found
+
+            o = ~in_leaf
+            o_desc = o & desc
+            o_kill = o & hard_bad            # slot permanently NONE
+            o_found = o & found
+            collide = o_found & jnp.any(out == item)
+            if leaf:
+                enter_leaf = o_found & ~collide & (item < 0)
+                direct = o_found & ~collide & (item >= 0)
+            else:
+                enter_leaf = jnp.bool_(False)
+                direct = o_found & ~collide
+            if ttype == 0:
+                direct_out = direct & is_out(weightv, item, x)
+            else:
+                direct_out = jnp.bool_(False)
+            place_direct = direct & ~direct_out
+
+            l = in_leaf
+            l_desc = l & desc
+            l_fail_now = l & hard_bad        # inner NONE -> outer break
+            l_found = l & found
+            l_rej = l_found & is_out(weightv, item, x)
+            l_place = l_found & ~l_rej
+            l_retry_cand = (l & empty) | l_rej
+            l_exhaust = l_retry_cand & (lftotal + 1 >= leaf_tries)
+            l_retry = l_retry_cand & ~l_exhaust
+
+            place = place_direct | l_place
+            kill = o_kill
+            # break: slot stays UNDEF for a later round
+            brk = (o & empty) | collide | direct_out | l_fail_now | l_exhaust
+
+            sel = jnp.arange(R) == slot
             out = jnp.where(
-                placed & (jnp.arange(numrep) == outpos), item, out
+                sel & place,
+                jnp.where(l_place, domain, item),
+                jnp.where(sel & kill, NONE, out),
             )
             if leaf:
                 out2 = jnp.where(
-                    placed & (jnp.arange(numrep) == outpos), leaf_item, out2
+                    sel & place, item, jnp.where(sel & kill, NONE, out2)
                 )
-            outpos = outpos + placed.astype(jnp.int32)
+            new_left = left - (place | kill).astype(jnp.int32)
 
-        return (out2 if leaf else out), outpos
+            finished = place | kill | brk
+            nxt, adv_ftotal, adv_done = slot_advance(
+                out, slot, new_left, ftotal
+            )
+            new_slot = jnp.where(finished, nxt, slot)
+            new_ftotal = jnp.where(finished, adv_ftotal, ftotal)
+            new_done = done | (finished & adv_done)
 
-    def leaf_indep(domain_item, x, rep, parent_r, numrep, weightv, tries):
-        """Inner chooseleaf indep: the recursive call with left=1 at
-        slot ``rep`` (outpos=rep), so r' = rep + parent_r + n*ftotal';
-        no collisions possible in a one-slot region."""
-        drow = row_of(domain_item)
+            stay_leaf = enter_leaf | l_desc | l_retry
+            new_mode = jnp.where(stay_leaf & ~finished, LEAF, OUTER)
+            new_row = jnp.where(
+                o_desc | l_desc | enter_leaf,
+                nrow,
+                jnp.where(
+                    l_retry & ~finished,
+                    row_of(domain),
+                    take_row,
+                ),
+            )
+            new_domain = jnp.where(enter_leaf, item, domain)
+            new_lftotal = jnp.where(
+                enter_leaf, 0, jnp.where(l_retry, lftotal + 1, lftotal)
+            )
+            new_depth = jnp.where(o_desc | l_desc, depth + 1, 0)
+            return (
+                new_done, new_slot, new_left, new_ftotal.astype(jnp.int32),
+                new_mode, new_row, new_domain,
+                new_lftotal.astype(jnp.int32), new_depth.astype(jnp.int32),
+                out, out2,
+            )
 
-        def cond(st):
-            ftotal, item = st
-            return (item == UNDEF) & (ftotal < tries)
-
-        def body(st):
-            ftotal, _ = st
-            r = rep + parent_r + numrep * ftotal
-            item, status = descend(drow, x, r, 0)
-            ok = (status == _FOUND) & ~is_out(weightv, item, x)
-            bad = status == _BAD
-            nitem = jnp.where(ok, item, jnp.where(bad, NONE, UNDEF))
-            return (ftotal + 1, nitem)
-
-        _, item = lax.while_loop(cond, body, (jnp.int32(0), UNDEF))
-        return jnp.where(item == UNDEF, NONE, item)
-
-    def choose_indep(take_row, x, left0, numrep, ttype, leaf, weightv, tries, leaf_tries):
-        """Top-level crush_choose_indep (outpos=0 frame, left0 slots;
-        ``numrep`` is the unclamped replica count — it sets the r'
-        stride even when left0 < numrep)."""
-        out = jnp.full((left0,), UNDEF, dtype=jnp.int32)
-        out2 = jnp.full((left0,), UNDEF, dtype=jnp.int32)
-
-        def cond(st):
-            out, _, left, ftotal = st
-            return (left > 0) & (ftotal < tries)
-
-        def body(st):
-            out, out2, left, ftotal = st
-            for rep in range(left0):
-                undef = out[rep] == UNDEF
-                r = rep + numrep * ftotal
-                item, status = descend(take_row, x, r, ttype)
-                ok = status == _FOUND
-                hard_bad = status == _BAD
-                collide = ok & jnp.any(out == item)
-                leaf_ok = jnp.bool_(True)
-                leaf_item = item
-                if leaf:
-                    is_bucket = item < 0
-                    li = leaf_indep(
-                        jnp.where(is_bucket, item, jnp.int32(-1)),
-                        x,
-                        rep,
-                        r,
-                        numrep,
-                        weightv,
-                        leaf_tries,
-                    )
-                    leaf_item = jnp.where(is_bucket, li, item)
-                    leaf_ok = jnp.where(is_bucket, li != NONE, True)
-                outed = (
-                    ok & (ttype == 0) & is_out(weightv, item, x)
-                    if ttype == 0
-                    else jnp.bool_(False)
-                )
-                place = undef & ok & (~collide) & leaf_ok & (~outed)
-                kill = undef & hard_bad  # slot permanently NONE
-                sel = jnp.arange(left0) == rep
-                out = jnp.where(
-                    sel & place, item, jnp.where(sel & kill, NONE, out)
-                )
-                if leaf:
-                    out2 = jnp.where(
-                        sel & place,
-                        leaf_item,
-                        jnp.where(sel & kill, NONE, out2),
-                    )
-                left = left - (place | kill).astype(jnp.int32)
-            return (out, out2, left, ftotal + 1)
-
-        out, out2, _, _ = lax.while_loop(
-            cond, body, (out, out2, jnp.int32(left0), jnp.int32(0))
+        init = (
+            jnp.bool_(R == 0) | jnp.bool_(tries <= 0),
+            jnp.int32(0), jnp.int32(R), jnp.int32(0),
+            OUTER, jnp.int32(take_row), jnp.int32(0), jnp.int32(0),
+            jnp.int32(0),
+            jnp.full((R,), UNDEF, dtype=jnp.int32),
+            jnp.full((R,), UNDEF, dtype=jnp.int32),
         )
+        st = lax.while_loop(cond, body, init)
+        out, out2 = st[9], st[10]
         out = jnp.where(out == UNDEF, NONE, out)
         out2 = jnp.where(out2 == UNDEF, NONE, out2)
-        return (out2 if leaf else out), jnp.int32(left0)
+        return (out2 if leaf else out), jnp.int32(R)
 
     def rule_fn(x, weightv):
         """Full do_rule for one x; returns (result, count) padded with
@@ -528,9 +688,9 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
             nslots = min(numrep, result_max)
             if take >= 0:
                 raise UnsupportedMap("TAKE of a device (not a bucket)")
-            if -1 - take >= cm.bidx.shape[0]:
+            if -1 - take >= len(cm.bidx):
                 raise UnsupportedMap(f"TAKE of unknown bucket {take}")
-            take_row = int(np.asarray(cm.bidx)[-1 - take])
+            take_row = cm.bidx[-1 - take]
             if take_row < 0:
                 raise UnsupportedMap(f"TAKE of unknown bucket {take}")
             firstn = op in (
@@ -549,7 +709,7 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
                 else:
                     leaf_tries = tries
                 got, n = choose_firstn(
-                    take_row, x, nslots, arg2, leaf, weightv,
+                    take_row, x, numrep, nslots, arg2, leaf, weightv,
                     tries, leaf_tries, vary_r, stable,
                 )
             else:
@@ -590,7 +750,7 @@ def batch_do_rule(
     padded with CRUSH_ITEM_NONE, counts (N,)).  ``weights`` is the
     16.16 device reweight vector."""
     if weights is None:
-        weights = np.full(max(cm.max_devices, 1), 0x10000, np.int64)
+        weights = np.full(max(cm.max_devices, 1), 0x10000, np.int32)
     xs = jnp.asarray(xs, dtype=jnp.int32)
-    wv = jnp.asarray(weights, dtype=jnp.int64)
+    wv = jnp.asarray(weights, dtype=jnp.int32)
     return _batched(cm, ruleno, result_max)(xs, wv)

@@ -34,10 +34,17 @@ def matrix_decode(
     """
     data_erasures = sorted(e for e in erasures if e < k)
     if data_erasures:
-        if decode_rows_fn is None:
-            rows, survivors = gf.make_decoding_matrix(matrix, erasures, k, w)
-        else:
-            rows, survivors = decode_rows_fn(erasures)
+        try:
+            if decode_rows_fn is None:
+                rows, survivors = gf.make_decoding_matrix(
+                    matrix, erasures, k, w
+                )
+            else:
+                rows, survivors = decode_rows_fn(erasures)
+        except ValueError as e:
+            from .interface import ErasureCodeError
+
+            raise ErasureCodeError(f"{e} (-EIO)")
         surv = np.stack([decoded[i] for i in survivors])
         rec = backend.matrix_regions(rows, surv, w)
         for idx, e in enumerate(data_erasures):

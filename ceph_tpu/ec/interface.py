@@ -173,14 +173,16 @@ class ErasureCode:
     def _decode(
         self, want_to_read: set[int], chunks: dict[int, np.ndarray]
     ) -> dict[int, np.ndarray]:
+        """ErasureCode.cc:206-242; note there is deliberately no
+        have-at-least-k guard — non-MDS codes (shec) decode from fewer
+        than k chunks, and each code family raises -EIO itself when its
+        recovery system is unsolvable."""
         have = set(chunks)
         if want_to_read <= have:
             return {i: chunks[i] for i in want_to_read}
         k, m = self.k, self.m
-        if len(have) < k:
-            raise ErasureCodeError(
-                f"need at least {k} chunks to decode, have {len(have)} (-EIO)"
-            )
+        if not chunks:
+            raise ErasureCodeError("no chunks to decode from (-EIO)")
         blocksize = len(next(iter(chunks.values())))
         decoded: dict[int, np.ndarray] = {}
         for i in range(k + m):

@@ -1,0 +1,149 @@
+"""LRC layered-code tests (modeled on TestErasureCodeLrc.cc)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import ErasureCodeProfile, registry_instance
+from ceph_tpu.ec.interface import ErasureCodeError
+
+
+def make(profile_dict):
+    return registry_instance().factory(
+        "lrc", ErasureCodeProfile(profile_dict)
+    )
+
+
+def payload(n=4096, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8
+    ).tobytes()
+
+
+def test_parse_kml_generates_layers():
+    ec = make({"k": "4", "m": "2", "l": "3"})
+    # (k+m)/l = 2 groups; mapping DD_ DD_ with group parity slots
+    assert ec.get_chunk_count() == 8
+    assert ec.get_data_chunk_count() == 4
+    assert len(ec.layers) == 3  # 1 global + 2 local
+
+
+def test_kml_encode_decode_roundtrip():
+    ec = make({"k": "4", "m": "2", "l": "3"})
+    data = payload()
+    encoded = ec.encode(set(range(8)), data)
+    assert len(encoded) == 8
+    # single erasure: recoverable from the local layer
+    for lost in range(8):
+        avail = {i: c for i, c in encoded.items() if i != lost}
+        decoded = ec._decode({lost}, avail)
+        np.testing.assert_array_equal(decoded[lost], encoded[lost])
+
+
+def test_kml_double_erasure():
+    ec = make({"k": "4", "m": "2", "l": "3"})
+    data = payload(8192, 1)
+    encoded = ec.encode(set(range(8)), data)
+    recovered = ec.decode_concat(
+        {i: c for i, c in encoded.items() if i not in (0, 5)}
+    )
+    assert recovered.tobytes()[: len(data)] == data
+
+
+def test_explicit_layers():
+    ec = make(
+        {
+            "mapping": "__DD__DD",
+            "layers": '[[ "_cDD_cDD", "" ], [ "cDDD____", "" ], '
+            '[ "____cDDD", "" ]]',
+        }
+    )
+    assert ec.get_chunk_count() == 8
+    assert ec.get_data_chunk_count() == 4
+    data = payload(4096, 2)
+    encoded = ec.encode(set(range(8)), data)
+    for lost in range(8):
+        avail = {i: c for i, c in encoded.items() if i != lost}
+        decoded = ec._decode({lost}, avail)
+        np.testing.assert_array_equal(decoded[lost], encoded[lost])
+
+
+def test_minimum_to_decode_prefers_local_group():
+    ec = make({"k": "4", "m": "2", "l": "3"})
+    # chunk layout: positions 0..7, groups {0,1,2,3(c)} is not literal —
+    # use the layer definitions to derive the local group of chunk 0
+    local = next(
+        layer for layer in reversed(ec.layers)
+        if 0 in layer.chunks_as_set
+    )
+    avail = set(range(8)) - {0}
+    minimum = ec.minimum_to_decode({0}, avail)
+    # the read set must stay inside chunk 0's local layer
+    assert set(minimum) <= local.chunks_as_set
+    assert len(minimum) < len(avail)
+
+
+def test_minimum_no_erasure_is_want():
+    ec = make({"k": "4", "m": "2", "l": "3"})
+    assert set(ec.minimum_to_decode({1, 2}, set(range(8)))) == {1, 2}
+
+
+def test_too_many_erasures_raises():
+    ec = make({"k": "4", "m": "2", "l": "3"})
+    data = payload(2048, 3)
+    encoded = ec.encode(set(range(8)), data)
+    lost = [0, 1, 3, 4, 6]  # more than any layer stack can absorb
+    avail = {i: c for i, c in encoded.items() if i not in lost}
+    with pytest.raises(ErasureCodeError):
+        ec._decode(set(lost), avail)
+
+
+def test_jax_backend_layers_match_numpy():
+    # layer profiles inherit nothing from the outer profile; pass
+    # backend through explicit layers instead
+    layers = (
+        '[[ "DDc_DDc_", {"backend": "jax"} ],'
+        ' [ "DDc_____", {"backend": "jax"} ],'
+        ' [ "____DDc_", {"backend": "jax"} ]]'
+    )
+    ecj = make({"mapping": "DD__DD__", "layers": layers})
+    ecn = make(
+        {
+            "mapping": "DD__DD__",
+            "layers": layers.replace('"jax"', '"numpy"'),
+        }
+    )
+    data = payload(8192, 4)
+    ej = ecj.encode(set(range(8)), data)
+    en = ecn.encode(set(range(8)), data)
+    for i in range(8):
+        np.testing.assert_array_equal(ej[i], en[i])
+
+
+def test_create_rule_places_groups():
+    from ceph_tpu.crush import CrushMap, CRUSH_BUCKET_STRAW2
+
+    m = CrushMap()
+    hosts = []
+    for h in range(8):
+        hosts.append(
+            m.add_bucket(
+                CRUSH_BUCKET_STRAW2,
+                1,
+                [h * 2, h * 2 + 1],
+                [0x10000] * 2,
+                name=f"host{h}",
+            )
+        )
+    m.add_bucket(
+        CRUSH_BUCKET_STRAW2,
+        3,
+        hosts,
+        [m.buckets[b].weight for b in hosts],
+        name="default",
+    )
+    ec = make({"k": "4", "m": "2", "l": "3"})
+    ruleno = ec.create_rule("lrc_rule", m)
+    res = m.do_rule(ruleno, 99, 8)
+    assert len(res) == 8
