@@ -13,10 +13,13 @@ EMIT groups.  Anything else raises UnsupportedMap and callers fall back
 to the exact Python oracle (ceph_tpu.crush.mapper) — the same
 plugin-style split the EC backends use.
 
-Exactness: int64 fixed-point draws (jax_enable_x64 required — enabled
-at import), identical hash/ln tables, and the same r'-advancement and
-retry semantics as mapper.c; verified against the oracle in
-tests/test_crush_jax.py.
+Exactness: every table lookup is a float32 one-hot matmul over
+24-bit-split tables (exact in the f32 mantissa), and all fixed-point
+arithmetic runs on float64 integers within the 2^53-exact range —
+see CompiledMap and _crush_ln_f64.  Same r'-advancement and retry
+semantics as mapper.c; verified against the oracle in
+tests/test_crush_jax.py (and _crush_ln_f64 value-exact over the full
+u16 domain).
 """
 
 from __future__ import annotations
@@ -81,6 +84,45 @@ def _hash2(a, b):
     x, a, h = _mix_inner(x0, a, h)
     b, y, h = _mix_inner(b, y0, h)
     return h.astype(jnp.uint32)
+
+
+def _crush_ln_f64(u, ln_tbl1, ln_tbl2):
+    """2^44*log2(u+1) exactly, in float64 (mapper.c:248-290).
+
+    Table halves are < 2^24 so the f32 one-hot matmuls are exact;
+    all arithmetic stays on integers < 2^53.  index2 reproduces
+    ((x*RH) >> 48) & 0xff via the 24-bit split (the C's int64
+    wraparound only ever touches bits that the mod-256 discards).
+    Value-exact against ceph_tpu.crush.ln.crush_ln over the full u16
+    domain (tests/test_crush_jax.py)."""
+    HIP = jax.lax.Precision.HIGHEST
+    x = u.astype(jnp.int32) + 1
+    masked = x & 0x1FFFF
+    nbits = jnp.zeros_like(x)
+    for shift in (16, 8, 4, 2, 1):
+        step = (masked >> shift) != 0
+        nbits = nbits + jnp.where(step, shift, 0)
+        masked = jnp.where(step, masked >> shift, masked)
+    bitlen = nbits + (masked != 0)
+    shift_amt = jnp.where((x & 0x18000) == 0, 16 - bitlen, 0)
+    x = x << shift_amt
+    iexp = 15 - shift_amt
+    k = ((x >> 8) << 1) - 256 >> 1
+    oh1 = (jnp.arange(129) == k[:, None]).astype(jnp.float32)
+    t4 = jnp.matmul(oh1, ln_tbl1, precision=HIP).astype(jnp.float64)
+    rh_hi, rh_lo = t4[:, 0], t4[:, 1]
+    lh_v = t4[:, 2] * float(1 << 24) + t4[:, 3]
+    xf = x.astype(jnp.float64)
+    T = xf * rh_hi + jnp.floor(xf * rh_lo / float(1 << 24))
+    index2 = jnp.mod(
+        jnp.floor(T / float(1 << 24)), 256.0
+    ).astype(jnp.int32)
+    oh2 = (jnp.arange(256) == index2[:, None]).astype(jnp.float32)
+    t2 = jnp.matmul(oh2, ln_tbl2, precision=HIP).astype(jnp.float64)
+    ll_v = t2[:, 0] * float(1 << 24) + t2[:, 1]
+    return iexp.astype(jnp.float64) * float(1 << 44) + jnp.floor(
+        (lh_v + ll_v) / 16.0
+    )
 
 
 # -- map compilation -------------------------------------------------------
@@ -286,47 +328,6 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
         oh = (jnp.arange(n) == i).astype(jnp.float32)
         return jnp.matmul(oh, table, precision=HIP)
 
-    def _crush_ln_f64(u):
-        """2^44*log2(u+1) exactly, in float64 (mapper.c:248-290).
-
-        Table halves are < 2^24 so the f32 one-hot matmuls are exact;
-        all arithmetic stays on integers < 2^53.  index2 reproduces
-        ((x*RH) >> 48) & 0xff via the 24-bit split (the C's int64
-        wraparound only ever touches bits that the mod-256 discards).
-        Verified value-exact against the int64 path over the full u16
-        domain."""
-        x = u.astype(jnp.int32) + 1
-        masked = x & 0x1FFFF
-        nbits = jnp.zeros_like(x)
-        for shift in (16, 8, 4, 2, 1):
-            step = (masked >> shift) != 0
-            nbits = nbits + jnp.where(step, shift, 0)
-            masked = jnp.where(step, masked >> shift, masked)
-        bitlen = nbits + (masked != 0)
-        shift_amt = jnp.where((x & 0x18000) == 0, 16 - bitlen, 0)
-        x = x << shift_amt
-        iexp = 15 - shift_amt
-        k = ((x >> 8) << 1) - 256 >> 1
-        oh1 = (jnp.arange(129) == k[:, None]).astype(jnp.float32)
-        t4 = jnp.matmul(oh1, cm.ln_tbl1, precision=HIP).astype(
-            jnp.float64
-        )
-        rh_hi, rh_lo = t4[:, 0], t4[:, 1]
-        lh_v = t4[:, 2] * float(1 << 24) + t4[:, 3]
-        xf = x.astype(jnp.float64)
-        T = xf * rh_hi + jnp.floor(xf * rh_lo / float(1 << 24))
-        index2 = jnp.mod(
-            jnp.floor(T / float(1 << 24)), 256.0
-        ).astype(jnp.int32)
-        oh2 = (jnp.arange(256) == index2[:, None]).astype(jnp.float32)
-        t2 = jnp.matmul(oh2, cm.ln_tbl2, precision=HIP).astype(
-            jnp.float64
-        )
-        ll_v = t2[:, 0] * float(1 << 24) + t2[:, 1]
-        return iexp.astype(jnp.float64) * float(1 << 44) + jnp.floor(
-            (lh_v + ll_v) / 16.0
-        )
-
     def straw2(bidx_row, x, r):
         """One straw2 draw-argmax (mapper.c:361-384); returns
         (item, bucket_size).
@@ -349,7 +350,7 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
             )
             & jnp.uint32(0xFFFF)
         )
-        L = float(1 << 48) - _crush_ln_f64(u)
+        L = float(1 << 48) - _crush_ln_f64(u, cm.ln_tbl1, cm.ln_tbl2)
         q0 = jnp.floor(L / jnp.where(wf > 0, wf, 1.0))
         t = q0 * wf
         q = (

@@ -380,6 +380,49 @@ class Liberation(_BitmatrixTechnique):
         self.bitmatrix = bm
 
 
+class BlaumRoth(Liberation):
+    """Blaum-Roth minimal-density RAID6: m=2 over the polynomial ring
+    R = GF(2)[x]/M_p(x) with p = w+1 prime and M_p = 1+x+...+x^w.
+    Q block for data column j is multiplication by x^j in R (the
+    mult-by-x matrix shifts coefficients up and folds the top
+    coefficient into every row, since x^w = Σ_{i<w} x^i).
+
+    Re-derivation note for parity review: the reference's generator
+    (blaum_roth_coding_bitmatrix) lives in the absent jerasure
+    submodule; this construction is the published Blaum-Roth code and
+    is validated by exhaustive-erasure roundtrips, not byte-parity
+    against the C library.
+    """
+
+    DEFAULT_K, DEFAULT_M, DEFAULT_W = 7, 2, 6
+    technique = "blaum_roth"
+
+    def _check_kw(self):
+        if self.k > self.w:
+            raise ErasureCodeError(f"k={self.k} must be <= w={self.w}")
+        # w=7 tolerated for Firefly compatibility
+        # (ErasureCodeJerasure.cc check_w)
+        if self.w != 7 and (self.w <= 2 or not _is_prime(self.w + 1)):
+            raise ErasureCodeError(
+                f"w={self.w} must be greater than two and w+1 must "
+                "be prime"
+            )
+
+    def prepare(self):
+        k, w = self.k, self.w
+        mult_x = np.zeros((w, w), dtype=np.uint8)
+        for i in range(w - 1):
+            mult_x[i + 1, i] = 1  # shift up
+        mult_x[:, w - 1] = 1  # fold x^w = sum of lower powers
+        bm = np.zeros((2 * w, k * w), dtype=np.uint8)
+        block = np.eye(w, dtype=np.uint8)
+        for j in range(k):
+            bm[:w, j * w : (j + 1) * w] = np.eye(w, dtype=np.uint8)
+            bm[w:, j * w : (j + 1) * w] = block
+            block = (mult_x @ block) % 2
+        self.bitmatrix = bm
+
+
 @register("jerasure")
 class ErasureCodePluginJerasure(ErasureCodePlugin):
     TECHNIQUES = {
@@ -388,10 +431,12 @@ class ErasureCodePluginJerasure(ErasureCodePlugin):
         "cauchy_orig": CauchyOrig,
         "cauchy_good": CauchyGood,
         "liberation": Liberation,
+        "blaum_roth": BlaumRoth,
     }
-    # blaum_roth/liber8tion: bitmatrix generators not yet rebuilt (gap
-    # tracked in docs/PARITY.md); the reference dispatch is
-    # ErasureCodePluginJerasure.cc:40-57.
+    # liber8tion (w=8 RAID6): its bitmatrix is a published table with
+    # no generating formula and the jerasure submodule carrying it is
+    # absent from the reference mount — gap tracked in docs/PARITY.md;
+    # the reference dispatch is ErasureCodePluginJerasure.cc:40-57.
 
     def make(self, profile: ErasureCodeProfile):
         technique = profile.get("technique", "reed_sol_van")

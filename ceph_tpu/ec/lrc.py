@@ -49,6 +49,7 @@ class ErasureCodeLrc(ErasureCode):
         super().__init__()
         self.layers: list[Layer] = []
         self.mapping = ""
+        self._backend = ""
         self.rule_steps: list[tuple[str, str, int]] = []
 
     # -- profile -----------------------------------------------------------
@@ -58,6 +59,9 @@ class ErasureCodeLrc(ErasureCode):
         self._layers_init()
 
     def parse(self, profile: ErasureCodeProfile) -> None:
+        # inner layers inherit the compute backend unless their own
+        # profile overrides it (clay does the same)
+        self._backend = profile.get("backend", "")
         self._parse_kml(profile)
         mapping = profile.get("mapping")
         if not mapping:
@@ -189,6 +193,8 @@ class ErasureCodeLrc(ErasureCode):
             prof.setdefault("m", str(len(layer.coding)))
             prof.setdefault("plugin", "jerasure")
             prof.setdefault("technique", "reed_sol_van")
+            if self._backend:
+                prof.setdefault("backend", self._backend)
             layer.erasure_code = instance().factory(prof["plugin"], prof)
 
     # -- geometry ----------------------------------------------------------

@@ -1,0 +1,176 @@
+"""Stripe layer — the batching seam (src/osd/ECUtil.{h,cc}).
+
+``StripeInfo`` is the stripe_width/chunk_size offset algebra
+(ECUtil.h:27-100).  ``encode``/``decode`` replace the reference's
+per-stripe plugin-call loop (ECUtil.cc:123-162, :12-48) with ONE
+batched device call across all stripes for matrix code families — the
+hoisted seam SURVEY.md §3.1 identifies — falling back to the per-stripe
+loop for layered codes.  ``HashInfo`` keeps the cumulative per-shard
+crc32c persisted as the hinfo xattr (ECUtil.cc:164-248).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..native import ceph_crc32c
+from .interface import ErasureCodeError
+
+
+class StripeInfo:
+    """stripe_width = k * chunk_size; logical↔chunk offset algebra."""
+
+    def __init__(self, k: int, stripe_width: int):
+        if stripe_width % k:
+            raise ErasureCodeError(
+                f"stripe_width {stripe_width} not divisible by k={k}"
+            )
+        self.stripe_width = stripe_width
+        self.chunk_size = stripe_width // k
+
+    def logical_aligned(self, offset: int) -> bool:
+        return offset % self.stripe_width == 0
+
+    def logical_to_prev_chunk_offset(self, offset: int) -> int:
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def logical_to_next_chunk_offset(self, offset: int) -> int:
+        return (
+            (offset + self.stripe_width - 1) // self.stripe_width
+        ) * self.chunk_size
+
+    def logical_to_prev_stripe_offset(self, offset: int) -> int:
+        return offset - (offset % self.stripe_width)
+
+    def logical_to_next_stripe_offset(self, offset: int) -> int:
+        rem = offset % self.stripe_width
+        return offset + (self.stripe_width - rem) if rem else offset
+
+    def aligned_logical_offset_to_chunk_offset(self, offset: int) -> int:
+        assert offset % self.stripe_width == 0
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def aligned_chunk_offset_to_logical_offset(self, offset: int) -> int:
+        assert offset % self.chunk_size == 0
+        return (offset // self.chunk_size) * self.stripe_width
+
+    def offset_len_to_stripe_bounds(
+        self, offset: int, length: int
+    ) -> tuple[int, int]:
+        start = self.logical_to_prev_stripe_offset(offset)
+        end = self.logical_to_next_stripe_offset(offset + length)
+        return start, end - start
+
+
+def encode(
+    sinfo: StripeInfo, ec, data: bytes | np.ndarray, want=None
+) -> dict[int, np.ndarray]:
+    """All stripes of ``data`` → per-shard concatenated chunks.
+
+    Matrix code families take the batched path: (B, k, chunk) in one
+    device call; others run the reference's per-stripe loop."""
+    buf = np.frombuffer(bytes(data), dtype=np.uint8) if isinstance(
+        data, (bytes, bytearray, memoryview)
+    ) else np.ascontiguousarray(data, dtype=np.uint8).ravel()
+    if len(buf) % sinfo.stripe_width:
+        raise ErasureCodeError(
+            f"logical size {len(buf)} not stripe aligned"
+        )
+    n = ec.get_chunk_count()
+    k = ec.get_data_chunk_count()
+    if want is None:
+        want = set(range(n))
+    nstripes = len(buf) // sinfo.stripe_width
+    if nstripes == 0:
+        return {}
+
+    matrix = getattr(ec, "matrix", None)
+    backend = getattr(ec, "backend", None)
+    if (
+        matrix is not None
+        # bitmatrix techniques (cauchy/liberation/blaum_roth) carry a
+        # .matrix too, but encode through XOR schedules over packet
+        # planes — the word-wise matrix path would corrupt them
+        and getattr(ec, "bitmatrix", None) is None
+        and backend is not None
+        and hasattr(backend, "matrix_stripes")
+        and not ec.get_chunk_mapping()
+    ):
+        stripes = buf.reshape(nstripes, k, sinfo.chunk_size)
+        coding = backend.matrix_stripes(matrix, stripes, ec.w)
+        out = {}
+        for i in range(k):
+            if i in want:
+                out[i] = np.ascontiguousarray(stripes[:, i, :]).reshape(-1)
+        for j in range(n - k):
+            if k + j in want:
+                out[k + j] = np.ascontiguousarray(
+                    coding[:, j, :]
+                ).reshape(-1)
+        return out
+
+    out = {i: [] for i in range(n)}
+    for s in range(nstripes):
+        stripe = buf[s * sinfo.stripe_width : (s + 1) * sinfo.stripe_width]
+        encoded = ec.encode(set(range(n)), stripe)
+        for i, chunk in encoded.items():
+            out[i].append(chunk)
+    return {
+        i: np.concatenate(parts) for i, parts in out.items() if i in want
+    }
+
+
+def decode_concat(
+    sinfo: StripeInfo, ec, shards: dict[int, np.ndarray]
+) -> np.ndarray:
+    """Concat-decode every stripe back to logical bytes
+    (ECUtil.cc:12-48)."""
+    lengths = {len(v) for v in shards.values()}
+    if len(lengths) != 1:
+        raise ErasureCodeError("shards must be equal length")
+    (shard_len,) = lengths
+    if shard_len % sinfo.chunk_size:
+        raise ErasureCodeError("shard length not chunk aligned")
+    nstripes = shard_len // sinfo.chunk_size
+    views = {
+        i: np.frombuffer(bytes(v), dtype=np.uint8)
+        if isinstance(v, (bytes, bytearray, memoryview))
+        else np.ascontiguousarray(v, dtype=np.uint8)
+        for i, v in shards.items()
+    }
+    out = []
+    for s in range(nstripes):
+        chunks = {
+            i: v[s * sinfo.chunk_size : (s + 1) * sinfo.chunk_size]
+            for i, v in views.items()
+        }
+        out.append(ec.decode_concat(chunks))
+    return np.concatenate(out)
+
+
+class HashInfo:
+    """Cumulative per-shard crc32c, persisted as the hinfo_key xattr
+    (ECUtil.cc:164-248); seeds start at -1 like the reference."""
+
+    def __init__(self, num_chunks: int):
+        self.cumulative_shard_hashes = [0xFFFFFFFF] * num_chunks
+        self.total_chunk_size = 0
+
+    def append(self, old_size: int, to_append: dict[int, np.ndarray]):
+        assert old_size == self.total_chunk_size
+        size = len(next(iter(to_append.values())))
+        for i, chunk in to_append.items():
+            assert len(chunk) == size
+            self.cumulative_shard_hashes[i] = ceph_crc32c(
+                self.cumulative_shard_hashes[i], bytes(chunk)
+            )
+        self.total_chunk_size += size
+
+    def get_chunk_hash(self, shard: int) -> int:
+        return self.cumulative_shard_hashes[shard]
+
+    def clear(self):
+        self.total_chunk_size = 0
+        self.cumulative_shard_hashes = [
+            0xFFFFFFFF for _ in self.cumulative_shard_hashes
+        ]

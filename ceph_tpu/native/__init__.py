@@ -1,0 +1,90 @@
+"""Native runtime pieces — C compiled on demand, loaded via ctypes.
+
+The reference's data plane is C++ throughout; here the TPU kernels are
+JAX and the host runtime stays Python except where byte-granular CPU
+work matters.  First resident: ceph_crc32c (shard hashes; the pure-
+Python fallback is table-exact but ~1000x slower).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import pathlib
+import subprocess
+import tempfile
+
+_SRC = pathlib.Path(__file__).parent / "crc32c.c"
+
+
+@functools.lru_cache(maxsize=1)
+def _lib():
+    """Build (once per user cache) and load the native library; None if
+    no C compiler works here.  Private 0700 cache dir + write-then-
+    rename keep a shared host from injecting or racing the build."""
+    build = (
+        pathlib.Path.home() / ".cache" / "ceph_tpu" / "native"
+    )
+    build.mkdir(parents=True, exist_ok=True, mode=0o700)
+    so = build / "libceph_tpu_crc32c.so"
+    try:
+        if not so.exists() or so.stat().st_mtime < _SRC.stat().st_mtime:
+            with tempfile.NamedTemporaryFile(
+                dir=build, suffix=".so", delete=False
+            ) as tmp:
+                tmp_path = pathlib.Path(tmp.name)
+            subprocess.run(
+                [
+                    "cc", "-O3", "-shared", "-fPIC",
+                    str(_SRC), "-o", str(tmp_path),
+                ],
+                check=True,
+                capture_output=True,
+            )
+            tmp_path.replace(so)
+        lib = ctypes.CDLL(str(so))
+        lib.ceph_crc32c.restype = ctypes.c_uint32
+        lib.ceph_crc32c.argtypes = [
+            ctypes.c_uint32,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        return lib
+    except (OSError, subprocess.CalledProcessError):
+        return None
+
+
+@functools.lru_cache(maxsize=1)
+def _py_table():
+    poly = 0x1EDC6F41
+
+    def rev8(b):
+        return int(f"{b:08b}"[::-1], 2)
+
+    def rev32(v):
+        return int(f"{v:032b}"[::-1], 2)
+
+    table = []
+    for i in range(256):
+        c = rev8(i) << 24
+        for _ in range(8):
+            c = ((c << 1) ^ poly) & 0xFFFFFFFF if c & 0x80000000 else (
+                c << 1
+            ) & 0xFFFFFFFF
+        table.append(rev32(c))
+    return table
+
+
+def ceph_crc32c(crc: int, data: bytes | memoryview) -> int:
+    """ceph_crc32c(seed, data) — matches src/include/crc32c.h semantics
+    (verified against the reference's test vectors in
+    src/test/common/test_crc32c.cc)."""
+    data = bytes(data)
+    lib = _lib()
+    if lib is not None:
+        return lib.ceph_crc32c(crc & 0xFFFFFFFF, data, len(data))
+    table = _py_table()
+    crc &= 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ table[(crc ^ b) & 0xFF]
+    return crc

@@ -1,0 +1,148 @@
+"""CLAY coupled-layer MSR tests (modeled on TestErasureCodeClay.cc)."""
+
+from __future__ import annotations
+
+from itertools import combinations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import ErasureCodeProfile, registry_instance
+from ceph_tpu.ec.interface import ErasureCodeError
+
+
+def make(**kv):
+    return registry_instance().factory("clay", ErasureCodeProfile(kv))
+
+
+def payload(ec, stripes=2, seed=0):
+    """A payload spanning a few full sub-chunked stripes."""
+    n = ec.get_chunk_size(1) * ec.k * stripes
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8
+    ).tobytes()
+
+
+def test_geometry():
+    ec = make(k="4", m="2", d="5")
+    assert (ec.q, ec.t, ec.nu) == (2, 3, 0)
+    assert ec.get_sub_chunk_count() == 8
+    assert ec.get_chunk_count() == 6
+
+
+def test_geometry_with_nu():
+    ec = make(k="3", m="2", d="4")  # k+m=5, q=2 -> nu=1
+    assert ec.nu == 1
+    assert ec.get_sub_chunk_count() == 2 ** 3
+
+
+def test_d_validation():
+    with pytest.raises(ErasureCodeError):
+        make(k="4", m="2", d="3")  # d < k
+    with pytest.raises(ErasureCodeError):
+        make(k="4", m="2", d="6")  # d > k+m-1
+
+
+def test_encode_decode_single_erasure():
+    ec = make(k="4", m="2", d="5")
+    data = payload(ec)
+    encoded = ec.encode(set(range(6)), data)
+    assert len(encoded) == 6
+    for lost in range(6):
+        avail = {i: c for i, c in encoded.items() if i != lost}
+        decoded = ec._decode({lost}, avail)
+        np.testing.assert_array_equal(decoded[lost], encoded[lost], lost)
+
+
+def test_encode_decode_double_erasure():
+    ec = make(k="4", m="2", d="5")
+    data = payload(ec, seed=1)
+    encoded = ec.encode(set(range(6)), data)
+    for lost in combinations(range(6), 2):
+        avail = {i: c for i, c in encoded.items() if i not in lost}
+        decoded = ec._decode(set(lost), avail)
+        for i in lost:
+            np.testing.assert_array_equal(
+                decoded[i], encoded[i], str(lost)
+            )
+
+
+def test_decode_concat_roundtrip():
+    ec = make(k="4", m="2", d="5")
+    data = payload(ec, seed=2)
+    encoded = ec.encode(set(range(6)), data)
+    avail = {i: c for i, c in encoded.items() if i not in (0, 4)}
+    assert ec.decode_concat(avail).tobytes()[: len(data)] == data
+
+
+def test_nu_shortened_code():
+    ec = make(k="3", m="2", d="4")
+    data = payload(ec, seed=3)
+    encoded = ec.encode(set(range(5)), data)
+    for lost in combinations(range(5), 2):
+        avail = {i: c for i, c in encoded.items() if i not in lost}
+        decoded = ec._decode(set(lost), avail)
+        for i in lost:
+            np.testing.assert_array_equal(
+                decoded[i], encoded[i], str(lost)
+            )
+
+
+def test_minimum_to_repair_reads_fraction():
+    """Single-chunk repair reads d helpers but only 1/q of each."""
+    ec = make(k="8", m="4", d="11")
+    n = ec.get_chunk_count()
+    avail = set(range(n)) - {3}
+    minimum = ec.minimum_to_decode({3}, avail)
+    assert len(minimum) == 11  # d helpers
+    total_sub = sum(c for runs in minimum.values() for _, c in runs)
+    per_helper = total_sub // len(minimum)
+    assert per_helper == ec.get_sub_chunk_count() // ec.q
+
+
+def test_repair_single_chunk_with_partial_reads():
+    """End-to-end minimum-bandwidth repair: helpers supply only the
+    sub-chunk runs minimum_to_decode asked for."""
+    ec = make(k="4", m="2", d="5")
+    data = payload(ec, seed=4)
+    encoded = ec.encode(set(range(6)), data)
+    chunk_size = len(encoded[0])
+    sc = chunk_size // ec.get_sub_chunk_count()
+    for lost in range(6):
+        avail = set(range(6)) - {lost}
+        minimum = ec.minimum_to_decode({lost}, avail)
+        assert len(minimum) == 5
+        partial = {}
+        for helper_id, runs in minimum.items():
+            parts = [
+                encoded[helper_id][off * sc : (off + cnt) * sc]
+                for off, cnt in runs
+            ]
+            partial[helper_id] = np.concatenate(parts)
+            assert len(partial[helper_id]) < chunk_size
+        repaired = ec.decode({lost}, partial, chunk_size)
+        np.testing.assert_array_equal(repaired[lost], encoded[lost], lost)
+
+
+def test_full_decode_when_not_repair_case():
+    """Multiple erasures fall back to the full layered decode."""
+    ec = make(k="4", m="2", d="5")
+    data = payload(ec, seed=5)
+    encoded = ec.encode(set(range(6)), data)
+    avail = {i: c for i, c in encoded.items() if i not in (1, 3)}
+    decoded = ec.decode({1, 3}, avail, len(encoded[0]))
+    np.testing.assert_array_equal(decoded[1], encoded[1])
+    np.testing.assert_array_equal(decoded[3], encoded[3])
+
+
+def test_k8m4_d11_headline_config():
+    """The BASELINE.md CLAY config."""
+    ec = make(k="8", m="4", d="11")
+    assert (ec.q, ec.t, ec.nu) == (4, 3, 0)
+    assert ec.get_sub_chunk_count() == 64
+    data = payload(ec, stripes=1, seed=6)
+    encoded = ec.encode(set(range(12)), data)
+    avail = {i: c for i, c in encoded.items() if i not in (2, 7, 11)}
+    decoded = ec._decode({2, 7, 11}, avail)
+    for i in (2, 7, 11):
+        np.testing.assert_array_equal(decoded[i], encoded[i])

@@ -261,49 +261,15 @@ def test_set_tries_zero_override_ignored_like_c():
 
 def test_device_crush_ln_exact_full_domain():
     """The f64 one-hot crush_ln must equal the int64 table version for
-    every 16-bit input."""
+    every 16-bit input — exercised on the PRODUCTION helper."""
     import jax
 
+    from ceph_tpu.crush.jaxmap import _crush_ln_f64
     from ceph_tpu.crush.ln import crush_ln as ln_ref
 
-    m = flat_map()
-    cm = compile_map(m)
-    # reach the traced helper through a tiny probe kernel
-    from ceph_tpu.crush.jaxmap import _make_rule_fn  # noqa: F401
-    import ceph_tpu.crush.jaxmap as J
-    import jax.numpy as jnp
-
-    HIP = jax.lax.Precision.HIGHEST
-
-    def ln_f64(u):
-        x = u.astype(jnp.int32) + 1
-        masked = x & 0x1FFFF
-        nbits = jnp.zeros_like(x)
-        for shift in (16, 8, 4, 2, 1):
-            step = (masked >> shift) != 0
-            nbits = nbits + jnp.where(step, shift, 0)
-            masked = jnp.where(step, masked >> shift, masked)
-        bitlen = nbits + (masked != 0)
-        shift_amt = jnp.where((x & 0x18000) == 0, 16 - bitlen, 0)
-        x = x << shift_amt
-        iexp = 15 - shift_amt
-        k = ((x >> 8) << 1) - 256 >> 1
-        oh1 = (jnp.arange(129) == k[:, None]).astype(jnp.float32)
-        t4 = jnp.matmul(oh1, cm.ln_tbl1, precision=HIP).astype(jnp.float64)
-        lh_v = t4[:, 2] * float(1 << 24) + t4[:, 3]
-        xf = x.astype(jnp.float64)
-        T = xf * t4[:, 0] + jnp.floor(xf * t4[:, 1] / float(1 << 24))
-        index2 = jnp.mod(jnp.floor(T / float(1 << 24)), 256.0).astype(
-            jnp.int32
-        )
-        oh2 = (jnp.arange(256) == index2[:, None]).astype(jnp.float32)
-        t2 = jnp.matmul(oh2, cm.ln_tbl2, precision=HIP).astype(jnp.float64)
-        ll_v = t2[:, 0] * float(1 << 24) + t2[:, 1]
-        return iexp.astype(jnp.float64) * float(1 << 44) + jnp.floor(
-            (lh_v + ll_v) / 16.0
-        )
-
+    cm = compile_map(flat_map())
     us = np.arange(0x10000, dtype=np.uint32)
-    got = np.asarray(jax.jit(ln_f64)(us)).astype(np.int64)
-    expect = ln_ref(us)
-    np.testing.assert_array_equal(got, expect)
+    got = np.asarray(
+        jax.jit(lambda u: _crush_ln_f64(u, cm.ln_tbl1, cm.ln_tbl2))(us)
+    ).astype(np.int64)
+    np.testing.assert_array_equal(got, ln_ref(us))

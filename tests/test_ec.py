@@ -161,3 +161,44 @@ def test_padding_partial_tail():
         out = ec.decode_concat(encoded).tobytes()
         assert out[:size] == payload
         assert all(b == 0 for b in out[size:])
+
+
+def test_blaum_roth_exhaustive_erasures():
+    """Blaum-Roth m=2 recovers any double erasure (MDS property of
+    the ring construction)."""
+    from itertools import combinations
+
+    ec = registry_instance().factory(
+        "jerasure",
+        ErasureCodeProfile(
+            technique="blaum_roth", k="5", m="2", w="6",
+            packetsize="16",
+        ),
+    )
+    data = np.random.default_rng(9).integers(
+        0, 256, 5 * ec.get_chunk_size(5 * 96), dtype=np.uint8
+    ).tobytes()
+    encoded = ec.encode(set(range(7)), data)
+    for lost in combinations(range(7), 2):
+        avail = {i: c for i, c in encoded.items() if i not in lost}
+        decoded = ec._decode(set(lost), avail)
+        for i in lost:
+            np.testing.assert_array_equal(
+                decoded[i], encoded[i], str(lost)
+            )
+
+
+def test_blaum_roth_w_validation():
+    with pytest.raises(ErasureCodeError):
+        registry_instance().factory(
+            "jerasure",
+            ErasureCodeProfile(technique="blaum_roth", k="4", m="2", w="8"),
+        )  # w+1=9 not prime
+    # w=7 tolerated for Firefly compatibility
+    ec = registry_instance().factory(
+        "jerasure",
+        ErasureCodeProfile(
+            technique="blaum_roth", k="4", m="2", w="7", packetsize="8"
+        ),
+    )
+    assert ec.w == 7
