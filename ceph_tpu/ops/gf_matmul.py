@@ -103,17 +103,20 @@ def gf_matrix_stripes(
 
 
 @functools.lru_cache(maxsize=512)
-def _bitmatrix_cache(key: bytes, shape: tuple, w: int) -> jnp.ndarray:
+def _bitmatrix_cache(key: bytes, shape: tuple, w: int, dtype) -> jnp.ndarray:
     from .. import gf
 
     mat = np.frombuffer(key, dtype=np.int64).reshape(shape)
-    return jnp.asarray(gf.jerasure_bitmatrix(mat, w), dtype=jnp.int8)
+    return jnp.asarray(gf.jerasure_bitmatrix(mat, w), dtype=dtype)
 
 
-def matrix_to_device_bitmatrix(matrix: np.ndarray, w: int) -> jnp.ndarray:
+def matrix_to_device_bitmatrix(
+    matrix: np.ndarray, w: int, dtype=jnp.int8
+) -> jnp.ndarray:
     """Lift a GF(2^w) matrix to its device-resident bitmatrix, cached by
     value — bitmatrix expansion AND host→device transfer happen once per
-    distinct matrix (the analog of ErasureCodeIsaTableCache's one-time
-    per-erasure-signature table preparation)."""
+    distinct (matrix, dtype) (the analog of ErasureCodeIsaTableCache's
+    one-time per-erasure-signature table preparation).  dtype jnp.int8
+    for the XLA int-matmul path, jnp.bfloat16 for the pallas kernel."""
     mat = np.ascontiguousarray(matrix, dtype=np.int64)
-    return _bitmatrix_cache(mat.tobytes(), mat.shape, w)
+    return _bitmatrix_cache(mat.tobytes(), mat.shape, w, dtype)

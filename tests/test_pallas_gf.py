@@ -1,0 +1,35 @@
+"""Pallas GF(2^8) kernel exactness (interpret mode off-TPU)."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from ceph_tpu import gf
+import jax.numpy as jnp
+
+from ceph_tpu.ops.gf_matmul import matrix_to_device_bitmatrix
+from ceph_tpu.ops.pallas_gf import TILE_N, gf8_regions_pallas
+
+
+def test_pallas_kernel_matches_oracle():
+    matrix = gf.reed_sol_vandermonde_coding_matrix(8, 3, 8)
+    bmbf = matrix_to_device_bitmatrix(matrix, 8, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    regions = rng.integers(0, 256, size=(8, TILE_N * 2), dtype=np.uint8)
+    interpret = jax.devices()[0].platform != "tpu"
+    got = np.asarray(
+        gf8_regions_pallas(bmbf, regions, m=3, interpret=interpret)
+    )
+    expect = gf.matrix_vector_mul_region(matrix, regions, 8)
+    np.testing.assert_array_equal(got, expect)
+
+def test_pallas_width_constraint_rejected():
+    import pytest
+
+    from ceph_tpu.ops.pallas_gf import gf8_matrix_regions
+
+    matrix = gf.reed_sol_vandermonde_coding_matrix(4, 2, 8)
+    with pytest.raises(ValueError):
+        gf8_matrix_regions(matrix, np.zeros((4, 100), dtype=np.uint8))
