@@ -1,0 +1,26 @@
+"""Common runtime: config schema/sources and performance counters.
+
+The reference's CephContext carries md_config_t (src/common/config.cc
+over the ~1,658 Option definitions in src/common/options.cc) and
+PerfCounters (src/common/perf_counters.cc); this package provides the
+same two services for the TPU framework's daemons and tools.
+"""
+
+from .config import Config, Option, OPT_INT, OPT_STR, OPT_BOOL, OPT_FLOAT
+from .perf_counters import (
+    PerfCounters,
+    PerfCountersBuilder,
+    PerfCountersCollection,
+)
+
+__all__ = [
+    "Config",
+    "Option",
+    "OPT_BOOL",
+    "OPT_FLOAT",
+    "OPT_INT",
+    "OPT_STR",
+    "PerfCounters",
+    "PerfCountersBuilder",
+    "PerfCountersCollection",
+]

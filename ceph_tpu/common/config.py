@@ -1,0 +1,267 @@
+"""Typed options + layered configuration (src/common/options.cc schema,
+src/common/config.cc semantics).
+
+One schema of typed ``Option`` definitions (level/desc/default/min-max/
+enum/see_also, options.cc's shape) consumed by ``Config``, which
+resolves values through the reference's precedence chain:
+
+    compiled defaults < conf file < environment < runtime set < override
+
+(config.cc: default/conf/env/mon/override).  Runtime ``set`` plays the
+ConfigMonitor role (centralized `ceph config set`); observers are
+notified when an option's effective value changes (config_obs.h).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+OPT_INT = "int"
+OPT_STR = "str"
+OPT_BOOL = "bool"
+OPT_FLOAT = "float"
+
+LEVEL_BASIC = "basic"
+LEVEL_ADVANCED = "advanced"
+LEVEL_DEV = "dev"
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class Option:
+    name: str
+    type: str = OPT_STR
+    default: Any = None
+    description: str = ""
+    level: str = LEVEL_ADVANCED
+    min: Any = None
+    max: Any = None
+    enum_allowed: tuple = ()
+    see_also: tuple = ()
+
+    def validate(self, value: Any) -> Any:
+        try:
+            if self.type == OPT_INT:
+                value = int(value)
+            elif self.type == OPT_FLOAT:
+                value = float(value)
+            elif self.type == OPT_BOOL:
+                if isinstance(value, str):
+                    low = value.lower()
+                    if low in ("yes", "true", "1", "on"):
+                        value = True
+                    elif low in ("no", "false", "0", "off"):
+                        value = False
+                    else:
+                        # strict like strict_strtob's -EINVAL
+                        raise ValueError(value)
+                else:
+                    value = bool(value)
+            else:
+                value = str(value)
+        except (TypeError, ValueError):
+            raise ConfigError(
+                f"{self.name}: {value!r} is not a valid {self.type}"
+            )
+        if self.min is not None and value < self.min:
+            raise ConfigError(
+                f"{self.name}: {value} < min {self.min}"
+            )
+        if self.max is not None and value > self.max:
+            raise ConfigError(
+                f"{self.name}: {value} > max {self.max}"
+            )
+        if self.enum_allowed and value not in self.enum_allowed:
+            raise ConfigError(
+                f"{self.name}: {value!r} not one of {self.enum_allowed}"
+            )
+        return value
+
+
+# The framework's option schema — the options.cc analog for the
+# components built so far (EC-relevant entries mirror options.cc:565,
+# :2717, :2723).
+SCHEMA: dict[str, Option] = {
+    opt.name: opt
+    for opt in [
+        Option(
+            "erasure_code_backend",
+            OPT_STR,
+            "jax",
+            "compute backend for erasure-code region math",
+            enum_allowed=("numpy", "jax"),
+        ),
+        Option(
+            "osd_erasure_code_plugins",
+            OPT_STR,
+            "jerasure isa lrc shec clay",
+            "erasure code plugins to preload at daemon start",
+        ),
+        Option(
+            "osd_pool_default_erasure_code_profile",
+            OPT_STR,
+            "plugin=jerasure technique=reed_sol_van k=2 m=1",
+            "default erasure code profile for new erasure-coded pools",
+        ),
+        Option(
+            "crush_backend",
+            OPT_STR,
+            "jax",
+            "batched PG mapping backend (jax device kernel or the "
+            "exact python oracle)",
+            enum_allowed=("oracle", "jax"),
+        ),
+        Option(
+            "crush_device_batch",
+            OPT_INT,
+            1 << 20,
+            "maximum PGs mapped per device call",
+            min=1,
+        ),
+        Option(
+            "osd_pool_default_size",
+            OPT_INT,
+            3,
+            "default replica count",
+            min=1,
+            level=LEVEL_BASIC,
+        ),
+        Option(
+            "osd_pool_default_pg_num",
+            OPT_INT,
+            32,
+            "default pg_num for new pools",
+            min=1,
+            level=LEVEL_BASIC,
+        ),
+        Option(
+            "ec_stripe_batch",
+            OPT_INT,
+            64,
+            "stripes folded into one device encode call",
+            min=1,
+        ),
+        Option(
+            "perf_enabled",
+            OPT_BOOL,
+            True,
+            "collect performance counters",
+        ),
+    ]
+}
+
+# precedence, lowest to highest (config.cc source ordering)
+_SOURCES = ("default", "file", "env", "runtime", "override")
+
+
+class Config:
+    """Layered config over a schema; the md_config_t role."""
+
+    def __init__(self, schema: dict[str, Option] | None = None):
+        self.schema = dict(schema or SCHEMA)
+        self._layers: dict[str, dict[str, Any]] = {
+            s: {} for s in _SOURCES
+        }
+        self._observers: list[Callable[[str, Any], None]] = []
+
+    # -- sources -----------------------------------------------------------
+    def parse_file(self, path: str) -> None:
+        """JSON conf file (the ceph.conf role).  Atomic: every key is
+        validated before any is applied."""
+        with open(path) as f:
+            data = json.load(f)
+        self._set_layer_many("file", data)
+
+    def parse_env(self, environ: dict | None = None) -> None:
+        """CEPH_TPU_<OPTION> environment overrides."""
+        environ = os.environ if environ is None else environ
+        updates = {}
+        for key, value in environ.items():
+            if not key.startswith("CEPH_TPU_"):
+                continue
+            # the prefix is ours, so an unknown suffix is always a
+            # user error — rejected like parse_file rejects it
+            updates[key[len("CEPH_TPU_"):].lower()] = value
+        self._set_layer_many("env", updates)
+
+    def set(self, name: str, value: Any) -> None:
+        """Runtime set — the `ceph config set` / ConfigMonitor path."""
+        self._set_layer("runtime", name, value)
+
+    def override(self, name: str, value: Any) -> None:
+        self._set_layer("override", name, value)
+
+    def rm(self, name: str, source: str = "runtime") -> None:
+        old = self.get(name)
+        self._layers[source].pop(name, None)
+        new = self.get(name)
+        if new != old:
+            self._notify(name, new)
+
+    def _set_layer_many(self, source: str, updates: dict) -> None:
+        """Validate every key first, then apply — a bad entry must not
+        leave the config half-updated with observers already fired."""
+        validated = {}
+        for name, value in updates.items():
+            opt = self.schema.get(name)
+            if opt is None:
+                raise ConfigError(f"unknown option {name!r}")
+            validated[name] = opt.validate(value)
+        for name, value in validated.items():
+            self._set_layer(source, name, value)
+
+    def _set_layer(self, source: str, name: str, value: Any) -> None:
+        opt = self.schema.get(name)
+        if opt is None:
+            raise ConfigError(f"unknown option {name!r}")
+        value = opt.validate(value)
+        old = self.get(name)
+        self._layers[source][name] = value
+        if self.get(name) != old:
+            self._notify(name, value)
+
+    # -- queries -----------------------------------------------------------
+    def get(self, name: str) -> Any:
+        opt = self.schema.get(name)
+        if opt is None:
+            raise ConfigError(f"unknown option {name!r}")
+        for source in reversed(_SOURCES):
+            if name in self._layers[source]:
+                return self._layers[source][name]
+        return opt.default
+
+    def get_source(self, name: str) -> str:
+        for source in reversed(_SOURCES):
+            if name in self._layers[source]:
+                return source
+        return "default"
+
+    def show_config(self) -> dict[str, Any]:
+        return {name: self.get(name) for name in sorted(self.schema)}
+
+    def diff(self) -> dict[str, dict]:
+        """Non-default values with their source (`ceph config diff`)."""
+        out = {}
+        for name, opt in self.schema.items():
+            value = self.get(name)
+            if value != opt.default:
+                out[name] = {
+                    "value": value,
+                    "source": self.get_source(name),
+                    "default": opt.default,
+                }
+        return out
+
+    # -- observers ---------------------------------------------------------
+    def add_observer(self, fn: Callable[[str, Any], None]) -> None:
+        self._observers.append(fn)
+
+    def _notify(self, name: str, value: Any) -> None:
+        for fn in self._observers:
+            fn(name, value)

@@ -22,6 +22,7 @@ from . import isa as _isa  # noqa: F401
 from . import lrc as _lrc  # noqa: F401
 from . import shec as _shec  # noqa: F401
 from . import clay as _clay  # noqa: F401
+from . import example as _example  # noqa: F401
 from .interface import ErasureCode, ErasureCodeProfile
 from .registry import ErasureCodePluginRegistry, instance as registry_instance
 

@@ -15,9 +15,16 @@ import threading
 
 from .interface import ErasureCodeError, ErasureCodeProfile
 
+# The registry refuses plugins built against another framework version,
+# mirroring the __erasure_code_version == CEPH_GIT_NICE_VER check at
+# dlopen time (ErasureCodePlugin.cc:138).
+FRAMEWORK_VERSION = "ceph-tpu-1"
+
 
 class ErasureCodePlugin:
     """Factory base: subclass and implement make(profile)."""
+
+    version = FRAMEWORK_VERSION
 
     def make(self, profile: ErasureCodeProfile):
         raise NotImplementedError
@@ -30,6 +37,16 @@ class ErasureCodePluginRegistry:
         self.disable_dlclose = False  # parity knob; unused
 
     def add(self, name: str, plugin: ErasureCodePlugin) -> None:
+        version = getattr(plugin, "version", None)
+        if version != FRAMEWORK_VERSION:
+            raise ErasureCodeError(
+                f"plugin {name}: version {version!r} does not match "
+                f"{FRAMEWORK_VERSION!r}"
+            )
+        if not callable(getattr(plugin, "make", None)):
+            raise ErasureCodeError(
+                f"plugin {name}: missing entry point make()"
+            )
         with self._lock:
             if name in self._plugins:
                 raise ErasureCodeError(f"plugin {name} already registered")

@@ -43,7 +43,9 @@ def _kernel(bm_ref, in_ref, out_ref):
     obits = acc.astype(jnp.int32) & 1
     obits = obits.reshape(m, 8, tn)
     weights = jax.lax.broadcasted_iota(jnp.int32, (m, 8, tn), 1)
-    packed = jnp.sum(obits << weights, axis=1)  # (m, TN)
+    # dtype pinned: under jax_enable_x64 the default sum promotes to
+    # int64, which Mosaic cannot lower
+    packed = jnp.sum(obits << weights, axis=1, dtype=jnp.int32)
     out_ref[:] = packed.astype(jnp.uint8)
 
 

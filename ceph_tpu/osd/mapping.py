@@ -50,9 +50,24 @@ def _compact_rows(osds: np.ndarray, valid: np.ndarray) -> np.ndarray:
     return np.where(keep, packed, _NONE)
 
 
+def _build_perf():
+    from ..common import PerfCountersBuilder
+
+    return (
+        PerfCountersBuilder("osdmap_mapping")
+        .add_u64_counter("updates", "full-map recomputes")
+        .add_u64_counter("pgs_mapped", "PGs mapped across updates")
+        .add_time_avg("crush_stage", "device/oracle CRUSH stage time")
+        .add_time_avg("fixup_stages", "host fix-up stage time")
+        .create_perf_counters()
+    )
+
+
 class OSDMapMapping:
     """Caches up/acting/primaries for every PG of every pool
-    (the consumer API of src/osd/OSDMapMapping.h:173-340)."""
+    (the consumer API of src/osd/OSDMapMapping.h:173-340); exposes
+    reference-style perf counters (the l_osd_* analog) via
+    ``self.perf.dump()``."""
 
     def __init__(self):
         self.up: dict[int, np.ndarray] = {}
@@ -60,13 +75,16 @@ class OSDMapMapping:
         self.acting: dict[int, np.ndarray] = {}
         self.acting_primary: dict[int, np.ndarray] = {}
         self.epoch = 0
+        self.perf = _build_perf()
 
     # -- batch pipeline ----------------------------------------------------
     def update(self, osdmap: OSDMap, use_device: bool = True) -> None:
         """Recompute every pool's full PG mapping."""
         self.epoch = osdmap.epoch
+        self.perf.inc("updates")
         for pool_id, pool in osdmap.pools.items():
             self._update_pool(osdmap, pool, use_device)
+            self.perf.inc("pgs_mapped", pool.pg_num)
 
     def _update_pool(
         self, osdmap: OSDMap, pool: PgPool, use_device: bool
@@ -76,8 +94,19 @@ class OSDMapMapping:
         ps = np.arange(n, dtype=np.int64)
         pps = pool_pps_vec(pool, ps).astype(np.int64)
 
-        raw = self._crush_stage(osdmap, pool, pps, use_device)
+        with self.perf.time_it("crush_stage"):
+            raw = self._crush_stage(osdmap, pool, pps, use_device)
 
+        with self.perf.time_it("fixup_stages"):
+            up, up_primary, acting, acting_primary = self._fixup(
+                osdmap, pool, ps, pps, raw
+            )
+        self.up[pool.pool_id] = up
+        self.up_primary[pool.pool_id] = up_primary
+        self.acting[pool.pool_id] = acting
+        self.acting_primary[pool.pool_id] = acting_primary
+
+    def _fixup(self, osdmap, pool, ps, pps, raw):
         # _remove_nonexistent_osds + _raw_to_up_osds, fused: both drop
         # to NONE (EC) or compact (replicated)
         exists = np.zeros(osdmap.max_osd + 1, dtype=bool)
@@ -111,10 +140,7 @@ class OSDMapMapping:
         acting_primary = up_primary.copy()
         self._temp_stage(osdmap, pool, acting, acting_primary)
 
-        self.up[pool.pool_id] = up
-        self.up_primary[pool.pool_id] = up_primary
-        self.acting[pool.pool_id] = acting
-        self.acting_primary[pool.pool_id] = acting_primary
+        return up, up_primary, acting, acting_primary
 
     def _crush_stage(
         self, osdmap: OSDMap, pool: PgPool, pps: np.ndarray, use_device: bool

@@ -7,9 +7,19 @@ Must run before the first ``import jax`` anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Default to the virtual CPU mesh, but honor an EXPLICIT opt-in to
+# hardware via CEPH_TPU_TEST_PLATFORM (the ambient JAX_PLATFORMS is
+# unreliable here: the launch environment pins it to its tunnel
+# backend, and hardware plugins may register regardless of the env
+# var — only the config API reliably selects the platform).
+_platform = os.environ.get("CEPH_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)

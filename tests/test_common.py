@@ -1,0 +1,121 @@
+"""Config + perf counters tests (SURVEY.md §5.5/§5.6)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from ceph_tpu.common import (
+    Config,
+    Option,
+    OPT_INT,
+    PerfCountersBuilder,
+    PerfCountersCollection,
+)
+from ceph_tpu.common.config import ConfigError, OPT_BOOL
+
+
+def test_config_precedence_chain(tmp_path):
+    cfg = Config()
+    assert cfg.get("osd_pool_default_size") == 3
+    conf = tmp_path / "conf.json"
+    conf.write_text(json.dumps({"osd_pool_default_size": 4}))
+    cfg.parse_file(str(conf))
+    assert cfg.get("osd_pool_default_size") == 4
+    cfg.parse_env({"CEPH_TPU_OSD_POOL_DEFAULT_SIZE": "5"})
+    assert cfg.get("osd_pool_default_size") == 5
+    cfg.set("osd_pool_default_size", 6)
+    assert cfg.get("osd_pool_default_size") == 6
+    cfg.override("osd_pool_default_size", 7)
+    assert cfg.get("osd_pool_default_size") == 7
+    assert cfg.get_source("osd_pool_default_size") == "override"
+    # removing higher layers falls back down the chain
+    cfg.rm("osd_pool_default_size", "override")
+    assert cfg.get("osd_pool_default_size") == 6
+
+
+def test_config_validation():
+    cfg = Config()
+    with pytest.raises(ConfigError):
+        cfg.set("osd_pool_default_size", "not-a-number")
+    with pytest.raises(ConfigError):
+        cfg.set("osd_pool_default_size", 0)  # min 1
+    with pytest.raises(ConfigError):
+        cfg.set("crush_backend", "gpu")  # enum
+    with pytest.raises(ConfigError):
+        cfg.set("no_such_option", 1)
+    cfg.set("perf_enabled", "false")
+    assert cfg.get("perf_enabled") is False
+
+
+def test_config_observers_and_diff():
+    cfg = Config()
+    seen = []
+    cfg.add_observer(lambda name, value: seen.append((name, value)))
+    cfg.set("crush_backend", "oracle")
+    cfg.set("crush_backend", "oracle")  # no change -> no notify
+    assert seen == [("crush_backend", "oracle")]
+    d = cfg.diff()
+    assert d["crush_backend"]["value"] == "oracle"
+    assert d["crush_backend"]["source"] == "runtime"
+
+
+def test_perf_counters_shapes():
+    pc = (
+        PerfCountersBuilder("ec")
+        .add_u64_counter("encode_ops")
+        .add_u64_gauge("inflight")
+        .add_time_avg("encode_lat")
+        .add_histogram("chunk_kb", [4, 64, 1024])
+        .create_perf_counters()
+    )
+    pc.inc("encode_ops", 3)
+    pc.inc("inflight")
+    pc.dec("inflight")
+    pc.tinc("encode_lat", 0.5)
+    pc.tinc("encode_lat", 1.5)
+    pc.hinc("chunk_kb", 3)
+    pc.hinc("chunk_kb", 100)
+    pc.hinc("chunk_kb", 999999)
+    d = pc.dump()
+    assert d["encode_ops"] == 3
+    assert d["inflight"] == 0
+    assert d["encode_lat"] == {"avgcount": 2, "sum": 2.0}
+    assert d["chunk_kb"]["buckets"] == [1, 0, 1, 1]
+    with pc.time_it("encode_lat"):
+        pass
+    assert pc.dump()["encode_lat"]["avgcount"] == 3
+    pc.reset()
+    assert pc.dump()["encode_ops"] == 0
+
+
+def test_perf_collection():
+    coll = PerfCountersCollection()
+    a = PerfCountersBuilder("a").add_u64_counter("x").create_perf_counters()
+    coll.add(a)
+    a.inc("x")
+    assert coll.dump() == {"a": {"x": 1}}
+    coll.remove("a")
+    assert coll.dump() == {}
+
+
+def test_mapping_exposes_perf():
+    from ceph_tpu.crush.builder import CrushMap
+    from ceph_tpu.crush.types import CRUSH_BUCKET_STRAW2, Tunables
+    from ceph_tpu.osd import OSDMap, OSDMapMapping, PgPool
+
+    m = CrushMap(tunables=Tunables(0, 0, 50, 1, 1, 1, 0))
+    root = m.add_bucket(
+        CRUSH_BUCKET_STRAW2, 3, [0, 1, 2], [0x10000] * 3, name="default"
+    )
+    rep = m.add_simple_rule("r", "default", "", mode="firstn")
+    om = OSDMap.build(m, 3)
+    om.add_pool(PgPool(pool_id=1, size=2, pg_num=8, crush_rule=rep))
+    mapping = OSDMapMapping()
+    mapping.update(om, use_device=False)
+    d = mapping.perf.dump()
+    assert d["updates"] == 1
+    assert d["pgs_mapped"] == 8
+    assert d["crush_stage"]["avgcount"] == 1
+    assert d["crush_stage"]["sum"] > 0

@@ -1,0 +1,91 @@
+"""Registry failure paths — the role of the six broken example plugins
+(TestErasureCodePlugin*.cc; dlopen failure modes translated to their
+python equivalents)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import ErasureCodeProfile, registry_instance
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.registry import (
+    FRAMEWORK_VERSION,
+    ErasureCodePlugin,
+    ErasureCodePluginRegistry,
+)
+
+
+def test_example_xor_roundtrip():
+    ec = registry_instance().factory("example", ErasureCodeProfile())
+    data = np.random.default_rng(0).integers(
+        0, 256, 1000, dtype=np.uint8
+    ).tobytes()
+    encoded = ec.encode({0, 1, 2}, data)
+    for lost in range(3):
+        avail = {i: c for i, c in encoded.items() if i != lost}
+        decoded = ec._decode({lost}, avail)
+        np.testing.assert_array_equal(decoded[lost], encoded[lost])
+    with pytest.raises(ErasureCodeError):
+        ec._decode({0, 1}, {2: encoded[2]})
+
+
+def test_version_mismatch_rejected():
+    reg = ErasureCodePluginRegistry()
+
+    class Stale(ErasureCodePlugin):
+        version = "ceph-tpu-0"
+
+        def make(self, profile):
+            raise AssertionError("unreachable")
+
+    with pytest.raises(ErasureCodeError, match="version"):
+        reg.add("stale", Stale())
+
+
+def test_missing_entry_point_rejected():
+    reg = ErasureCodePluginRegistry()
+
+    class NoMake:
+        version = FRAMEWORK_VERSION
+        make = None
+
+    with pytest.raises(ErasureCodeError, match="entry point"):
+        reg.add("nomake", NoMake())
+
+
+def test_fail_to_initialize_surfaces_error():
+    reg = ErasureCodePluginRegistry()
+
+    class Exploding(ErasureCodePlugin):
+        def make(self, profile):
+            raise ErasureCodeError("cannot initialize")
+
+    reg.add("exploding", Exploding())
+    with pytest.raises(ErasureCodeError, match="cannot initialize"):
+        reg.factory("exploding", ErasureCodeProfile())
+
+
+def test_fail_to_register_is_unknown_plugin():
+    reg = ErasureCodePluginRegistry()
+    with pytest.raises(ErasureCodeError, match="not registered"):
+        reg.factory("never_registered", ErasureCodeProfile())
+
+
+def test_double_registration_rejected():
+    reg = ErasureCodePluginRegistry()
+
+    class P(ErasureCodePlugin):
+        def make(self, profile):
+            raise AssertionError
+
+    reg.add("p", P())
+    with pytest.raises(ErasureCodeError, match="already registered"):
+        reg.add("p", P())
+
+
+def test_preload():
+    reg = registry_instance()
+    reg.preload(["jerasure", "isa", "lrc", "shec", "clay", "example"])
+    with pytest.raises(ErasureCodeError):
+        reg.preload(["jerasure", "libec_missing"])
