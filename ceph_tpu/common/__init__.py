@@ -6,7 +6,9 @@ PerfCounters (src/common/perf_counters.cc); this package provides the
 same two services for the TPU framework's daemons and tools.
 """
 
+from .admin_socket import AdminSocket, admin_command
 from .config import Config, Option, OPT_INT, OPT_STR, OPT_BOOL, OPT_FLOAT
+from .op_tracker import OpTracker, TrackedOp
 from .perf_counters import (
     PerfCounters,
     PerfCountersBuilder,
@@ -14,7 +16,11 @@ from .perf_counters import (
 )
 
 __all__ = [
+    "AdminSocket",
+    "admin_command",
     "Config",
+    "OpTracker",
+    "TrackedOp",
     "Option",
     "OPT_BOOL",
     "OPT_FLOAT",

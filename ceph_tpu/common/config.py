@@ -159,6 +159,9 @@ SCHEMA: dict[str, Option] = {
 # precedence, lowest to highest (config.cc source ordering)
 _SOURCES = ("default", "file", "env", "runtime", "override")
 
+# harness env vars that share the prefix but are not config options
+_RESERVED_ENV = frozenset({"CEPH_TPU_TEST_PLATFORM"})
+
 
 class Config:
     """Layered config over a schema; the md_config_t role."""
@@ -183,7 +186,7 @@ class Config:
         environ = os.environ if environ is None else environ
         updates = {}
         for key, value in environ.items():
-            if not key.startswith("CEPH_TPU_"):
+            if not key.startswith("CEPH_TPU_") or key in _RESERVED_ENV:
                 continue
             # the prefix is ours, so an unknown suffix is always a
             # user error — rejected like parse_file rejects it
@@ -214,13 +217,16 @@ class Config:
                 raise ConfigError(f"unknown option {name!r}")
             validated[name] = opt.validate(value)
         for name, value in validated.items():
-            self._set_layer(source, name, value)
+            self._apply(source, name, value)
 
     def _set_layer(self, source: str, name: str, value: Any) -> None:
         opt = self.schema.get(name)
         if opt is None:
             raise ConfigError(f"unknown option {name!r}")
-        value = opt.validate(value)
+        self._apply(source, name, opt.validate(value))
+
+    def _apply(self, source: str, name: str, value: Any) -> None:
+        """Store an already-validated value and notify on change."""
         old = self.get(name)
         self._layers[source][name] = value
         if self.get(name) != old:

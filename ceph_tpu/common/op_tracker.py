@@ -1,0 +1,131 @@
+"""Op tracking — in-flight op registry + historic ring buffer
+(src/common/TrackedOp.cc; dumped as dump_ops_in_flight /
+dump_historic_ops over the admin socket).
+
+A TrackedOp accumulates per-stage timestamped events ("queued",
+"reached_pg", "commit_sent", ...); on completion it moves into a
+bounded history keyed for the slowest-ops view.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+
+class TrackedOp:
+    def __init__(self, tracker: "OpTracker", description: str):
+        self._tracker = tracker
+        self.seq = next(tracker._seq)
+        self.description = description
+        self.initiated_at = time.time()
+        self.events: list[tuple[float, str]] = []
+        self._done = False
+
+    def mark_event(self, event: str) -> None:
+        self.events.append((time.time(), event))
+
+    def finish(self) -> None:
+        if not self._done:
+            self._done = True
+            self.mark_event("done")
+            self._tracker._complete(self)
+
+    def __enter__(self):
+        self.mark_event("start")
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        self.mark_event("exception" if exc_type else "finish")
+        self.finish()
+        return False
+
+    @property
+    def duration(self) -> float:
+        end = self.events[-1][0] if self._done else time.time()
+        return end - self.initiated_at
+
+    def dump(self) -> dict:
+        return {
+            "seq": self.seq,
+            "description": self.description,
+            "initiated_at": self.initiated_at,
+            "duration": self.duration,
+            "type_data": {
+                "events": [
+                    {"time": t, "event": e} for t, e in self.events
+                ]
+            },
+        }
+
+
+class OpTracker:
+    """history_size/history_duration mirror
+    osd_op_history_size/duration's roles."""
+
+    def __init__(self, history_size: int = 20, history_duration: float = 600.0):
+        self._seq = itertools.count()
+        self._lock = threading.Lock()
+        self._inflight: dict[int, TrackedOp] = {}
+        self._history: deque[TrackedOp] = deque()
+        self.history_size = history_size
+        self.history_duration = history_duration
+
+    def create_op(self, description: str) -> TrackedOp:
+        op = TrackedOp(self, description)
+        with self._lock:
+            self._inflight[op.seq] = op
+        return op
+
+    def _complete(self, op: TrackedOp) -> None:
+        with self._lock:
+            self._inflight.pop(op.seq, None)
+            self._history.append(op)
+            now = time.time()
+            while len(self._history) > self.history_size or (
+                self._history
+                and now - self._history[0].initiated_at
+                > self.history_duration
+            ):
+                self._history.popleft()
+
+    # -- admin socket views ------------------------------------------------
+    def dump_ops_in_flight(self) -> dict:
+        with self._lock:
+            ops = [op.dump() for op in self._inflight.values()]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_ops(self) -> dict:
+        with self._lock:
+            ops = [op.dump() for op in self._history]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_slow_ops(self, threshold: float = 0.0) -> dict:
+        with self._lock:
+            ops = sorted(
+                (op for op in self._history if op.duration >= threshold),
+                key=lambda o: o.duration,
+                reverse=True,
+            )
+            return {"num_ops": len(ops), "ops": [o.dump() for o in ops]}
+
+    def register_admin_commands(self, admin_socket) -> None:
+        admin_socket.register_command(
+            "dump_ops_in_flight",
+            lambda args: self.dump_ops_in_flight(),
+            "show in-flight ops",
+        )
+        admin_socket.register_command(
+            "dump_historic_ops",
+            lambda args: self.dump_historic_ops(),
+            "show recent completed ops",
+        )
+        admin_socket.register_command(
+            "dump_historic_slow_ops",
+            lambda args: self.dump_historic_slow_ops(
+                float(args.get("threshold", 0.0))
+            ),
+            "show recent ops sorted by duration",
+        )

@@ -13,12 +13,12 @@ from __future__ import annotations
 
 import threading
 
+from ..version import FRAMEWORK_VERSION
 from .interface import ErasureCodeError, ErasureCodeProfile
 
 # The registry refuses plugins built against another framework version,
 # mirroring the __erasure_code_version == CEPH_GIT_NICE_VER check at
 # dlopen time (ErasureCodePlugin.cc:138).
-FRAMEWORK_VERSION = "ceph-tpu-1"
 
 
 class ErasureCodePlugin:

@@ -1,0 +1,94 @@
+"""Admin socket + op tracker tests (SURVEY.md §5.1/§5.5)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.common import (
+    AdminSocket,
+    Config,
+    OpTracker,
+    PerfCountersBuilder,
+    PerfCountersCollection,
+    admin_command,
+)
+
+
+@pytest.fixture
+def sock(tmp_path):
+    perf = PerfCountersCollection()
+    pc = (
+        PerfCountersBuilder("ec")
+        .add_u64_counter("encodes")
+        .create_perf_counters()
+    )
+    perf.add(pc)
+    pc.inc("encodes", 5)
+    asok = AdminSocket(str(tmp_path / "daemon.asok"), Config(), perf)
+    tracker = OpTracker(history_size=4)
+    tracker.register_admin_commands(asok)
+    asok.tracker = tracker
+    with asok:
+        yield asok
+
+
+def test_perf_dump_over_socket(sock):
+    out = admin_command(sock.path, "perf dump")
+    assert out["ok"]["ec"]["encodes"] == 5
+
+
+def test_config_roundtrip_over_socket(sock):
+    out = admin_command(
+        sock.path,
+        {"prefix": "config set", "var": "crush_backend", "val": "oracle"},
+    )
+    assert out["ok"] == {"success": True}
+    out = admin_command(
+        sock.path, {"prefix": "config get", "var": "crush_backend"}
+    )
+    assert out["ok"] == {"crush_backend": "oracle"}
+    out = admin_command(sock.path, "config diff")
+    assert out["ok"]["crush_backend"]["source"] == "runtime"
+
+
+def test_unknown_command_and_bad_args(sock):
+    assert "error" in admin_command(sock.path, "nope")
+    out = admin_command(
+        sock.path,
+        {"prefix": "config set", "var": "crush_backend", "val": "gpu"},
+    )
+    assert "error" in out
+
+
+def test_help_and_version(sock):
+    out = admin_command(sock.path, "help")
+    assert "perf dump" in out["ok"]
+    assert admin_command(sock.path, "version")["ok"]["version"]
+
+
+def test_op_tracker_flow(sock):
+    tracker = sock.tracker
+    with tracker.create_op("client.write pg 1.2") as op:
+        op.mark_event("queued")
+        op.mark_event("commit")
+        inflight = admin_command(sock.path, "dump_ops_in_flight")
+        assert inflight["ok"]["num_ops"] == 1
+    done = admin_command(sock.path, "dump_historic_ops")
+    assert done["ok"]["num_ops"] == 1
+    events = [e["event"] for e in done["ok"]["ops"][0]["type_data"]["events"]]
+    assert events == ["start", "queued", "commit", "finish", "done"]
+    assert admin_command(sock.path, "dump_ops_in_flight")["ok"]["num_ops"] == 0
+
+
+def test_op_history_bounded(sock):
+    tracker = sock.tracker
+    for i in range(10):
+        with tracker.create_op(f"op{i}"):
+            pass
+    hist = tracker.dump_historic_ops()
+    assert hist["num_ops"] == 4  # history_size
+    slow = tracker.dump_historic_slow_ops()
+    durations = [o["duration"] for o in slow["ops"]]
+    assert durations == sorted(durations, reverse=True)

@@ -1,0 +1,109 @@
+"""Upmap balancer tests (the calc_pg_upmaps role)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.builder import CrushMap
+from ceph_tpu.crush.types import (
+    CRUSH_BUCKET_STRAW2,
+    CRUSH_ITEM_NONE,
+    PG_POOL_TYPE_ERASURE,
+    Tunables,
+)
+from ceph_tpu.osd import OSDMap, OSDMapMapping, PgPool
+from ceph_tpu.osd.balancer import calc_pg_upmaps
+
+JEWEL = Tunables(0, 0, 50, 1, 1, 1, 0)
+
+
+def skewed_cluster(nhosts=6, per_host=4, pg_num=256):
+    """Unequal host weights make CRUSH leave residual imbalance for the
+    balancer to clean up."""
+    m = CrushMap(tunables=JEWEL)
+    hosts = []
+    for h in range(nhosts):
+        items = [h * per_host + i for i in range(per_host)]
+        weights = [0x10000 + (h % 3) * 0x4000] * per_host
+        hosts.append(
+            m.add_bucket(CRUSH_BUCKET_STRAW2, 1, items, weights,
+                         name=f"host{h}")
+        )
+    m.add_bucket(
+        CRUSH_BUCKET_STRAW2, 3, hosts,
+        [m.buckets[b].weight for b in hosts], name="default",
+    )
+    rep = m.add_simple_rule("rep", "default", "host", mode="firstn")
+    om = OSDMap.build(m, nhosts * per_host)
+    om.add_pool(PgPool(pool_id=1, size=3, pg_num=pg_num, crush_rule=rep))
+    return om
+
+
+def _deviations(om):
+    mapping = OSDMapMapping()
+    mapping.update(om)
+    counts = np.zeros(om.max_osd)
+    up = mapping.up[1]
+    for row in up:
+        for o in row:
+            if o != CRUSH_ITEM_NONE:
+                counts[int(o)] += 1
+    return counts, mapping
+
+
+def _targets(om, nhosts=6, per_host=4):
+    """Weight-proportional per-OSD PG targets (the balancer's goal is
+    NOT uniform counts — hosts have different weights)."""
+    weights = np.array(
+        [1.0 + (h % 3) * 0.25 for h in range(nhosts) for _ in range(per_host)]
+    )
+    pool = om.pools[1]
+    return pool.size * pool.pg_num * weights / weights.sum()
+
+
+def test_balancer_reduces_deviation_from_target():
+    om = skewed_cluster()
+    target = _targets(om)
+    before, _ = _deviations(om)
+    changed = calc_pg_upmaps(om, max_deviation=1, max_changes=50)
+    assert changed > 0
+    after, _ = _deviations(om)
+    assert np.abs(after - target).max() < np.abs(before - target).max()
+    assert after.sum() == before.sum()  # no PGs lost
+
+
+def test_balancer_respects_failure_domains():
+    om = skewed_cluster()
+    calc_pg_upmaps(om, max_deviation=1, max_changes=50)
+    mapping = OSDMapMapping()
+    mapping.update(om)
+    per_host = 4
+    for ps in range(om.pools[1].pg_num):
+        up = [int(o) for o in mapping.up[1][ps] if o != CRUSH_ITEM_NONE]
+        hosts = [o // per_host for o in up]
+        assert len(set(hosts)) == len(hosts), (ps, up)
+
+
+def test_balancer_upmaps_are_pipeline_valid():
+    om = skewed_cluster()
+    calc_pg_upmaps(om, max_deviation=1, max_changes=30)
+    assert om.pg_upmap_items
+    for (pid, ps), items in om.pg_upmap_items.items():
+        up, _, _, _ = om.pg_to_up_acting_osds(pid, ps)
+        for src, dst in items:
+            assert src not in up
+            assert dst in up
+
+
+def test_balancer_max_changes_bound():
+    om = skewed_cluster()
+    changed = calc_pg_upmaps(om, max_deviation=1, max_changes=3)
+    assert changed <= 3
+
+
+def test_balancer_noop_when_balanced():
+    om = skewed_cluster()
+    calc_pg_upmaps(om, max_deviation=1, max_changes=200)
+    again = calc_pg_upmaps(om, max_deviation=1, max_changes=200)
+    assert again == 0
