@@ -1,0 +1,21 @@
+"""Object storage layer.
+
+``objectstore`` is the transactional store boundary
+(src/os/ObjectStore.h + Transaction) with a RAM implementation
+(src/os/memstore/); ``ec_store`` is the erasure-coded data plane over
+it — the simplified ECBackend: full-stripe writes through the batched
+encode seam, reconstructing reads, HashInfo scrub, and single-shard
+recovery with minimum reads (src/osd/ECBackend.cc's read/write/
+recovery paths without the messenger hop).
+"""
+
+from .ec_store import ECStore, ScrubResult
+from .objectstore import MemStore, ObjectStore, Transaction
+
+__all__ = [
+    "ECStore",
+    "MemStore",
+    "ObjectStore",
+    "ScrubResult",
+    "Transaction",
+]

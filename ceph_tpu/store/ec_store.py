@@ -1,0 +1,268 @@
+"""ECStore — the erasure-coded data plane over per-shard object stores
+(the simplified ECBackend, src/osd/ECBackend.cc).
+
+One ObjectStore per shard plays the k+m OSDs.  Writes are full-object:
+pad to stripe multiples, batch-encode through the stripe seam, land
+each shard + its cumulative HashInfo crc in ONE transaction per shard
+(ECTransaction::encode_and_write's shape: shard writes and hinfo
+travel together).  Reads fetch the k data shards, crc-verify, and
+widen to reconstruction only when one is missing or corrupt
+(objects_read_and_reconstruct).  ``recover_shard`` rebuilds one shard
+from its minimum read set with REAL ranged reads — for CLAY profiles
+those are fractional-chunk reads (the ECUtil::decode sub-chunk
+plumbing) — and falls back to a crc-verified full decode if a helper
+was silently corrupt.  ``scrub`` is the per-shard crc audit of a PG
+deep scrub.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..ec import ErasureCodeProfile, registry_instance
+from ..ec.interface import ErasureCodeError
+from ..ec.stripe import HashInfo, StripeInfo, decode_concat, encode as stripe_encode
+from ..native import ceph_crc32c
+from .objectstore import MemStore, ObjectStore, StoreError, Transaction
+
+HINFO_KEY = "hinfo_key"  # the xattr name the reference uses
+
+
+class ScrubResult:
+    def __init__(self):
+        self.missing: list[int] = []
+        self.corrupt: list[int] = []
+
+    @property
+    def clean(self) -> bool:
+        return not self.missing and not self.corrupt
+
+    def __repr__(self):
+        return (
+            f"ScrubResult(missing={self.missing}, corrupt={self.corrupt})"
+        )
+
+
+class ECStore:
+    def __init__(
+        self,
+        plugin: str = "jerasure",
+        profile: dict | None = None,
+        stores: list[ObjectStore] | None = None,
+        stripe_width: int | None = None,
+    ):
+        prof = ErasureCodeProfile(profile or {})
+        self.ec = registry_instance().factory(plugin, prof)
+        self.k = self.ec.get_data_chunk_count()
+        self.n = self.ec.get_chunk_count()
+        chunk = self.ec.get_chunk_size(
+            stripe_width if stripe_width else self.k * 4096
+        )
+        self.sinfo = StripeInfo(self.k, self.k * chunk)
+        self.stores = stores or [MemStore() for _ in range(self.n)]
+        assert len(self.stores) == self.n
+        self.cid = "ec_pool"
+        for store in self.stores:
+            try:
+                store.queue_transaction(
+                    Transaction().create_collection(self.cid)
+                )
+            except StoreError:
+                pass  # already created
+
+    # -- write path --------------------------------------------------------
+    def put(self, name: str, data: bytes) -> None:
+        """Full-object write: pad to stripes, batch encode, one
+        transaction per shard carrying chunk bytes + hinfo."""
+        logical = len(data)
+        padded_len = self.sinfo.logical_to_next_stripe_offset(logical)
+        padded = data + b"\0" * (padded_len - logical)
+        shards = stripe_encode(self.sinfo, self.ec, padded)
+        if not shards:  # zero-length object: n empty shards
+            shards = {
+                i: np.zeros(0, dtype=np.uint8) for i in range(self.n)
+            }
+        hinfo = HashInfo(self.n)
+        hinfo.append(0, shards)
+        meta = {
+            "size": logical,
+            "hashes": hinfo.cumulative_shard_hashes,
+        }
+        for i, store in enumerate(self.stores):
+            self._write_shard(store, name, bytes(shards[i]), meta)
+
+    def _write_shard(
+        self, store: ObjectStore, name: str, shard: bytes, meta: dict
+    ) -> None:
+        """The one shard-write shape (remove+touch+write+hinfo in a
+        single transaction), shared by put and recovery."""
+        txn = Transaction()
+        if store.exists(self.cid, name):
+            txn.remove(self.cid, name)
+        txn.touch(self.cid, name)
+        txn.write(self.cid, name, 0, shard)
+        txn.setattr(self.cid, name, HINFO_KEY, json.dumps(meta).encode())
+        store.queue_transaction(txn)
+
+    # -- read path ---------------------------------------------------------
+    def _shard_meta(self, name: str) -> dict:
+        for store in self.stores:
+            try:
+                return json.loads(store.getattr(self.cid, name, HINFO_KEY))
+            except StoreError:
+                continue
+        raise ErasureCodeError(f"object {name} not found (-ENOENT)")
+
+    def _read_verified(self, name: str, meta: dict, shard: int):
+        try:
+            raw = self.stores[shard].read(self.cid, name)
+        except StoreError:
+            return None
+        if ceph_crc32c(0xFFFFFFFF, raw) != meta["hashes"][shard]:
+            return None
+        return np.frombuffer(raw, dtype=np.uint8)
+
+    def _gather(
+        self, name: str, meta: dict, want: set[int] | None = None
+    ) -> dict[int, np.ndarray]:
+        """crc-verified shard reads; corrupt/missing shards are simply
+        absent, like failed shard reads."""
+        shards: dict[int, np.ndarray] = {}
+        for i in range(self.n) if want is None else sorted(want):
+            got = self._read_verified(name, meta, i)
+            if got is not None:
+                shards[i] = got
+        return shards
+
+    def get(self, name: str) -> bytes:
+        """Read with reconstruction
+        (ECBackend::objects_read_and_reconstruct): fast path reads only
+        the k data shards; any failure widens to every shard."""
+        meta = self._shard_meta(name)
+        if meta["size"] == 0:
+            return b""
+        want = {self.ec.chunk_index(i) for i in range(self.k)}
+        chunks = self._gather(name, meta, want)
+        if set(chunks) != want:
+            chunks = self._gather(name, meta)  # reconstruct path
+        data = decode_concat(self.sinfo, self.ec, chunks)
+        return bytes(data[: meta["size"]])
+
+    # -- scrub / recovery --------------------------------------------------
+    def scrub(self, name: str) -> ScrubResult:
+        """Per-shard crc audit (the deep-scrub hinfo check)."""
+        meta = self._shard_meta(name)
+        result = ScrubResult()
+        for i, store in enumerate(self.stores):
+            try:
+                raw = store.read(self.cid, name)
+            except StoreError:
+                result.missing.append(i)
+                continue
+            if ceph_crc32c(0xFFFFFFFF, raw) != meta["hashes"][i]:
+                result.corrupt.append(i)
+        return result
+
+    def recover_shard(self, name: str, shard: int) -> int:
+        """Rebuild one shard from its minimum read set and rewrite it
+        (RecoveryOp: READING -> WRITING).  Reads are REAL ranged
+        store reads; a failed rebuild crc (silently corrupt helper)
+        falls back to a crc-verified full decode.  Returns helper
+        bytes read."""
+        meta = self._shard_meta(name)
+        available = {
+            i
+            for i in range(self.n)
+            if i != shard and self.stores[i].exists(self.cid, name)
+        }
+        read_bytes = 0
+        rebuilt = None
+        try:
+            rebuilt, read_bytes = self._repair_minimum(
+                name, meta, shard, available
+            )
+        except (ErasureCodeError, StoreError):
+            rebuilt = None
+        if (
+            rebuilt is None
+            or ceph_crc32c(0xFFFFFFFF, bytes(rebuilt))
+            != meta["hashes"][shard]
+        ):
+            # helper was corrupt or repair unsupported: verified path
+            shards = self._gather(name, meta)
+            shards.pop(shard, None)
+            read_bytes += sum(len(c) for c in shards.values())
+            decoded = self.ec._decode({shard}, shards)
+            rebuilt = np.ascontiguousarray(decoded[shard], dtype=np.uint8)
+            if (
+                ceph_crc32c(0xFFFFFFFF, bytes(rebuilt))
+                != meta["hashes"][shard]
+            ):
+                raise ErasureCodeError(
+                    f"rebuilt shard {shard} fails its hinfo crc (-EIO)"
+                )
+        self._write_shard(
+            self.stores[shard], name, bytes(rebuilt), meta
+        )
+        return read_bytes
+
+    def _repair_minimum(self, name, meta, shard, available):
+        """Minimum-read rebuild with ranged reads (trusting helpers,
+        like the reference's repair reads — corruption is caught by the
+        rebuilt-shard crc)."""
+        minimum = self.ec.minimum_to_decode({shard}, available)
+        chunk_len = self.sinfo.chunk_size
+        shard_len = self.stores[next(iter(minimum))].stat(self.cid, name)
+        sub_count = self.ec.get_sub_chunk_count()
+        read_bytes = 0
+        if sub_count > 1 and any(
+            runs != [(0, sub_count)] for runs in minimum.values()
+        ):
+            # fractional repair, stripe by stripe (the ECUtil::decode
+            # subchunk loop, src/osd/ECUtil.cc:82-116)
+            nstripes = shard_len // chunk_len
+            sc = chunk_len // sub_count
+            parts = []
+            for s in range(nstripes):
+                base = s * chunk_len
+                partial = {}
+                for helper, runs in minimum.items():
+                    segs = [
+                        self.stores[helper].read(
+                            self.cid, name, base + off * sc, cnt * sc
+                        )
+                        for off, cnt in runs
+                    ]
+                    buf = np.frombuffer(
+                        b"".join(segs), dtype=np.uint8
+                    )
+                    read_bytes += len(buf)
+                    partial[helper] = buf
+                decoded = self.ec.decode({shard}, partial, chunk_len)
+                parts.append(decoded[shard])
+            return np.concatenate(parts), read_bytes
+        chunks = {}
+        for helper in minimum:
+            raw = self.stores[helper].read(self.cid, name)
+            read_bytes += len(raw)
+            chunks[helper] = np.frombuffer(raw, dtype=np.uint8)
+        decoded = self.ec._decode({shard}, chunks)
+        return (
+            np.ascontiguousarray(decoded[shard], dtype=np.uint8),
+            read_bytes,
+        )
+
+    # -- fault injection (the OSDThrasher role, §4.3) ----------------------
+    def lose_shard(self, name: str, shard: int) -> None:
+        self.stores[shard].queue_transaction(
+            Transaction().remove(self.cid, name)
+        )
+
+    def corrupt_shard(self, name: str, shard: int, offset: int = 0) -> None:
+        raw = bytearray(self.stores[shard].read(self.cid, name))
+        raw[offset] ^= 0xFF
+        self.stores[shard].queue_transaction(
+            Transaction().write(self.cid, name, 0, bytes(raw))
+        )

@@ -1,0 +1,250 @@
+"""Transactional object store boundary (src/os/ObjectStore.h,
+src/os/Transaction.h) with a RAM backend (src/os/memstore/).
+
+A Transaction is an ordered op list applied atomically by
+``queue_transaction`` — all or nothing, like the reference's contract
+(BlueStore gets atomicity from its WAL; memstore from applying to a
+per-object shadow and merging only on success).  Objects are byte
+arrays with xattrs, grouped into collections.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass, field
+
+
+class StoreError(Exception):
+    pass
+
+
+@dataclass
+class _Object:
+    data: bytearray = field(default_factory=bytearray)
+    xattrs: dict[str, bytes] = field(default_factory=dict)
+
+
+class Transaction:
+    """Ordered op list (Transaction.h's op encoding, as python ops)."""
+
+    def __init__(self):
+        self.ops: list[tuple] = []
+
+    def create_collection(self, cid: str):
+        self.ops.append(("mkcoll", cid, None))
+        return self
+
+    def touch(self, cid: str, oid: str):
+        self.ops.append(("touch", cid, oid))
+        return self
+
+    def write(self, cid: str, oid: str, offset: int, data: bytes):
+        self.ops.append(("write", cid, oid, offset, bytes(data)))
+        return self
+
+    def truncate(self, cid: str, oid: str, size: int):
+        self.ops.append(("truncate", cid, oid, size))
+        return self
+
+    def setattr(self, cid: str, oid: str, name: str, value: bytes):
+        self.ops.append(("setattr", cid, oid, name, bytes(value)))
+        return self
+
+    def rmattr(self, cid: str, oid: str, name: str):
+        self.ops.append(("rmattr", cid, oid, name))
+        return self
+
+    def remove(self, cid: str, oid: str):
+        self.ops.append(("remove", cid, oid))
+        return self
+
+    def remove_collection(self, cid: str):
+        self.ops.append(("rmcoll", cid, None))
+        return self
+
+
+class ObjectStore:
+    """The abstract boundary (ObjectStore.h): transactions in, reads
+    out."""
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        raise NotImplementedError
+
+    def read(self, cid: str, oid: str, offset: int = 0, length: int = -1) -> bytes:
+        raise NotImplementedError
+
+    def getattr(self, cid: str, oid: str, name: str) -> bytes:
+        raise NotImplementedError
+
+    def stat(self, cid: str, oid: str) -> int:
+        raise NotImplementedError
+
+    def exists(self, cid: str, oid: str) -> bool:
+        raise NotImplementedError
+
+    def list_objects(self, cid: str) -> list[str]:
+        raise NotImplementedError
+
+
+class _TxnState:
+    """Shadow state for one transaction: copies only the objects the
+    op list names; collections created/removed are tracked as deltas."""
+
+    __slots__ = ("store", "objects", "new_colls", "dead_colls")
+
+    def __init__(self, store: "MemStore"):
+        self.store = store
+        # (cid, oid) -> _Object copy or None (= removed)
+        self.objects: dict[tuple[str, str], _Object | None] = {}
+        self.new_colls: set[str] = set()
+        self.dead_colls: set[str] = set()
+
+    def coll_exists(self, cid: str) -> bool:
+        if cid in self.dead_colls:
+            return False
+        return cid in self.new_colls or cid in self.store._colls
+
+    def get(self, cid: str, oid: str, create: bool = False):
+        if not self.coll_exists(cid):
+            raise StoreError(f"no collection {cid} (-ENOENT)")
+        key = (cid, oid)
+        if key in self.objects:
+            obj = self.objects[key]
+        else:
+            src = self.store._colls.get(cid, {}).get(oid)
+            obj = copy.deepcopy(src) if src is not None else None
+            self.objects[key] = obj
+        if obj is None and create:
+            obj = _Object()
+            self.objects[key] = obj
+        return obj
+
+    def coll_empty(self, cid: str) -> bool:
+        live = set(self.store._colls.get(cid, {}))
+        for (c, oid), obj in self.objects.items():
+            if c != cid:
+                continue
+            if obj is None:
+                live.discard(oid)
+            else:
+                live.add(oid)
+        return not live
+
+
+class MemStore(ObjectStore):
+    """RAM ObjectStore (src/os/memstore/) with per-object
+    copy-on-write transaction shadows."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._colls: dict[str, dict[str, _Object]] = {}
+
+    # -- transactions ------------------------------------------------------
+    def queue_transaction(self, txn: Transaction) -> None:
+        with self._lock:
+            st = _TxnState(self)
+            for op in txn.ops:
+                self._apply(st, op)
+            # commit
+            for cid in st.dead_colls:
+                self._colls.pop(cid, None)
+            for cid in st.new_colls:
+                self._colls.setdefault(cid, {})
+            for (cid, oid), obj in st.objects.items():
+                if cid in st.dead_colls or cid not in self._colls:
+                    continue
+                if obj is None:
+                    self._colls[cid].pop(oid, None)
+                else:
+                    self._colls[cid][oid] = obj
+
+    def _apply(self, st: _TxnState, op) -> None:
+        kind, cid, oid = op[0], op[1], op[2]
+        if kind == "mkcoll":
+            if st.coll_exists(cid):
+                raise StoreError(f"collection {cid} exists (-EEXIST)")
+            st.dead_colls.discard(cid)
+            st.new_colls.add(cid)
+            return
+        if kind == "rmcoll":
+            if not st.coll_exists(cid):
+                raise StoreError(f"no collection {cid} (-ENOENT)")
+            if not st.coll_empty(cid):
+                raise StoreError(f"collection {cid} not empty (-ENOTEMPTY)")
+            st.new_colls.discard(cid)
+            st.dead_colls.add(cid)
+            return
+        if kind == "touch":
+            st.get(cid, oid, create=True)
+        elif kind == "write":
+            _, _, _, offset, data = op
+            obj = st.get(cid, oid, create=True)
+            end = offset + len(data)
+            if len(obj.data) < end:
+                obj.data.extend(b"\0" * (end - len(obj.data)))
+            obj.data[offset:end] = data
+        elif kind == "truncate":
+            _, _, _, size = op
+            obj = st.get(cid, oid, create=True)
+            if len(obj.data) > size:
+                del obj.data[size:]
+            else:
+                obj.data.extend(b"\0" * (size - len(obj.data)))
+        elif kind == "setattr":
+            _, _, _, name, value = op
+            obj = st.get(cid, oid)
+            if obj is None:
+                raise StoreError(f"no object {cid}/{oid} (-ENOENT)")
+            obj.xattrs[name] = value
+        elif kind == "rmattr":
+            _, _, _, name = op
+            obj = st.get(cid, oid)
+            if obj is None or name not in obj.xattrs:
+                raise StoreError(f"no attr {name} on {cid}/{oid} (-ENODATA)")
+            del obj.xattrs[name]
+        elif kind == "remove":
+            obj = st.get(cid, oid)
+            if obj is None:
+                raise StoreError(f"no object {cid}/{oid} (-ENOENT)")
+            st.objects[(cid, oid)] = None
+        else:
+            raise StoreError(f"unknown op {kind}")
+
+    # -- reads -------------------------------------------------------------
+    def _get(self, cid: str, oid: str) -> _Object:
+        coll = self._colls.get(cid)
+        if coll is None:
+            raise StoreError(f"no collection {cid} (-ENOENT)")
+        obj = coll.get(oid)
+        if obj is None:
+            raise StoreError(f"no object {cid}/{oid} (-ENOENT)")
+        return obj
+
+    def read(self, cid, oid, offset=0, length=-1) -> bytes:
+        with self._lock:
+            data = self._get(cid, oid).data
+            if length < 0:
+                return bytes(data[offset:])
+            return bytes(data[offset : offset + length])
+
+    def getattr(self, cid, oid, name) -> bytes:
+        with self._lock:
+            obj = self._get(cid, oid)
+            if name not in obj.xattrs:
+                raise StoreError(f"no attr {name} (-ENODATA)")
+            return obj.xattrs[name]
+
+    def stat(self, cid, oid) -> int:
+        with self._lock:
+            return len(self._get(cid, oid).data)
+
+    def exists(self, cid, oid) -> bool:
+        with self._lock:
+            return oid in self._colls.get(cid, {})
+
+    def list_objects(self, cid) -> list[str]:
+        with self._lock:
+            if cid not in self._colls:
+                raise StoreError(f"no collection {cid} (-ENOENT)")
+            return sorted(self._colls[cid])
