@@ -1,0 +1,119 @@
+"""Failure detection — heartbeats + failure reports
+(OSD::handle_osd_ping / send_failures, src/osd/OSD.cc:5235,5889, and
+OSDMonitor::prepare_failure's reporter-count gate).
+
+Each OSD pings its heartbeat peers; a peer silent past the grace
+period generates a failure report, and the monitor-side aggregator
+marks an OSD down once enough DISTINCT reporters agree — then the map
+epoch bumps and the batched mapper recomputes placements (elasticity
+is CRUSH remap, SURVEY.md §5.3)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..common.log import dout
+from .osdmap import OSDMap
+
+OSD_HEARTBEAT_GRACE = 20.0  # osd_heartbeat_grace
+MON_OSD_MIN_DOWN_REPORTERS = 2  # mon_osd_min_down_reporters
+
+
+class HeartbeatTracker:
+    """One OSD's view of its peers (the 4-messenger ping plane,
+    collapsed to timestamps)."""
+
+    def __init__(self, whoami: int, grace: float = OSD_HEARTBEAT_GRACE):
+        self.whoami = whoami
+        self.grace = grace
+        self._last_rx: dict[int, float] = {}
+
+    def peers(self) -> set[int]:
+        return set(self._last_rx)
+
+    def add_peer(self, osd: int, now: float) -> None:
+        self._last_rx.setdefault(osd, now)
+
+    def remove_peer(self, osd: int) -> None:
+        self._last_rx.pop(osd, None)
+
+    def handle_ping(self, from_osd: int, now: float) -> None:
+        if from_osd in self._last_rx:
+            self._last_rx[from_osd] = now
+
+    def failures(self, now: float) -> list[tuple[int, float]]:
+        """(peer, seconds_silent) past grace — the send_failures
+        payload."""
+        out = []
+        for osd, last in self._last_rx.items():
+            silent = now - last
+            if silent >= self.grace:
+                out.append((osd, silent))
+        return out
+
+
+@dataclass
+class _Pending:
+    reporters: dict[int, float] = field(default_factory=dict)
+
+
+class FailureAggregator:
+    """Monitor-side reporter-count gate
+    (OSDMonitor::prepare_failure/check_failure, simplified to the
+    distinct-reporter threshold)."""
+
+    def __init__(
+        self,
+        osdmap: OSDMap,
+        min_reporters: int = MON_OSD_MIN_DOWN_REPORTERS,
+    ):
+        self.osdmap = osdmap
+        self.min_reporters = min_reporters
+        self._pending: dict[int, _Pending] = {}
+
+    def report_failure(
+        self, target: int, reporter: int, now: float
+    ) -> bool:
+        """Returns True when the report tipped ``target`` down."""
+        if not self.osdmap.is_up(target):
+            # target already down through some other path: drop any
+            # stale pending entry so it cannot pre-count a future
+            # down marking
+            self._pending.pop(target, None)
+            return False
+        if not self.osdmap.is_up(reporter):
+            return False  # dead reporters don't count
+        p = self._pending.setdefault(target, _Pending())
+        p.reporters[reporter] = now
+        # reporters that died since reporting no longer count
+        p.reporters = {
+            r: t for r, t in p.reporters.items() if self.osdmap.is_up(r)
+        }
+        dout(
+            "osd",
+            5,
+            f"failure report: osd.{target} by osd.{reporter} "
+            f"({len(p.reporters)}/{self.min_reporters})",
+        )
+        if len(p.reporters) >= self.min_reporters:
+            self._mark_down(target)
+            return True
+        return False
+
+    def cancel_report(self, target: int, reporter: int) -> None:
+        """The MOSDFailure recovery path: a reporter hearing the target
+        again withdraws its report."""
+        p = self._pending.get(target)
+        if p:
+            p.reporters.pop(reporter, None)
+            if not p.reporters:
+                del self._pending[target]
+
+    def _mark_down(self, target: int) -> None:
+        self.osdmap.mark_down(target)
+        self.osdmap.epoch += 1
+        self._pending.pop(target, None)
+        dout("osd", 0, f"osd.{target} marked down, epoch -> {self.osdmap.epoch}")
+
+    def pending_reports(self) -> dict[int, int]:
+        return {t: len(p.reporters) for t, p in self._pending.items()}

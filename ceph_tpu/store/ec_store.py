@@ -146,7 +146,12 @@ class ECStore:
         want = {self.ec.chunk_index(i) for i in range(self.k)}
         chunks = self._gather(name, meta, want)
         if set(chunks) != want:
-            chunks = self._gather(name, meta)  # reconstruct path
+            # reconstruct path: top up with the shards not yet read
+            chunks.update(
+                self._gather(
+                    name, meta, set(range(self.n)) - set(chunks)
+                )
+            )
         data = decode_concat(self.sinfo, self.ec, chunks)
         return bytes(data[: meta["size"]])
 
@@ -183,7 +188,9 @@ class ECStore:
             rebuilt, read_bytes = self._repair_minimum(
                 name, meta, shard, available
             )
-        except (ErasureCodeError, StoreError):
+        except (ErasureCodeError, StoreError, ValueError):
+            # ValueError: a truncated helper shard breaks the array
+            # shapes; the verified path below filters it out by crc
             rebuilt = None
         if (
             rebuilt is None

@@ -1,0 +1,133 @@
+"""Logging + failure-detection tests (SURVEY.md §5.3/§5.5)."""
+
+from __future__ import annotations
+
+import pytest
+
+from ceph_tpu.common import AdminSocket, admin_command
+from ceph_tpu.common.log import Log
+from ceph_tpu.crush.builder import CrushMap
+from ceph_tpu.crush.types import CRUSH_BUCKET_STRAW2, Tunables
+from ceph_tpu.osd import OSDMap, OSDMapMapping, PgPool
+from ceph_tpu.osd.failure import FailureAggregator, HeartbeatTracker
+
+
+def test_log_levels_and_ring():
+    log = Log(max_recent=3)
+    log.set_level("crush", 10)
+    log.dout("crush", 5, "kept")
+    log.dout("crush", 20, "dropped")  # above level
+    log.dout("ec", 1, "kept too")
+    recent = log.dump_recent()
+    assert [e["message"] for e in recent] == ["kept", "kept too"]
+    for i in range(5):
+        log.dout("crush", 1, f"m{i}")
+    assert len(log.dump_recent()) == 3  # ring bound
+    assert log.dump_recent()[-1]["message"] == "m4"
+
+
+def test_log_admin_commands(tmp_path):
+    log = Log()
+    asok = AdminSocket(str(tmp_path / "a.asok"))
+    log.register_admin_commands(asok)
+    with asok:
+        admin_command(
+            asok.path,
+            {"prefix": "log set-level", "subsys": "crush", "level": "1"},
+        )
+        log.dout("crush", 1, "visible")
+        log.dout("crush", 2, "gated")
+        out = admin_command(asok.path, "log dump")
+    messages = [e["message"] for e in out["ok"]]
+    assert "visible" in messages and "gated" not in messages
+
+
+def _cluster():
+    m = CrushMap(tunables=Tunables(0, 0, 50, 1, 1, 1, 0))
+    hosts = []
+    for h in range(3):
+        hosts.append(
+            m.add_bucket(
+                CRUSH_BUCKET_STRAW2, 1, [h * 2, h * 2 + 1],
+                [0x10000] * 2, name=f"h{h}",
+            )
+        )
+    m.add_bucket(
+        CRUSH_BUCKET_STRAW2, 3, hosts,
+        [m.buckets[b].weight for b in hosts], name="default",
+    )
+    rep = m.add_simple_rule("r", "default", "host")
+    om = OSDMap.build(m, 6)
+    om.add_pool(PgPool(pool_id=1, size=3, pg_num=32, crush_rule=rep))
+    return om
+
+
+def test_heartbeat_grace():
+    hb = HeartbeatTracker(whoami=0, grace=20)
+    for peer in (1, 2, 3):
+        hb.add_peer(peer, now=100.0)
+    hb.handle_ping(1, now=120.0)
+    hb.handle_ping(2, now=105.0)
+    fails = dict(hb.failures(now=131.0))
+    assert 1 not in fails  # 11s silent < grace
+    assert fails[2] == pytest.approx(26.0)
+    assert fails[3] == pytest.approx(31.0)
+
+
+def test_failure_reports_mark_down_and_remap():
+    om = _cluster()
+    agg = FailureAggregator(om, min_reporters=2)
+    mapping = OSDMapMapping()
+    mapping.update(om, use_device=False)
+    before_epoch = om.epoch
+    assert not agg.report_failure(4, reporter=0, now=1.0)
+    assert om.is_up(4)
+    assert agg.report_failure(4, reporter=1, now=2.0)  # 2nd reporter tips
+    assert not om.is_up(4)
+    assert om.epoch == before_epoch + 1
+    # elasticity: recompute moves PGs off the dead OSD
+    mapping.update(om, use_device=False)
+    for ps in range(32):
+        up, _, _, _ = mapping.get(1, ps)
+        assert 4 not in up
+
+
+def test_duplicate_and_dead_reporters_do_not_count():
+    om = _cluster()
+    agg = FailureAggregator(om, min_reporters=2)
+    assert not agg.report_failure(3, reporter=0, now=1.0)
+    assert not agg.report_failure(3, reporter=0, now=2.0)  # same reporter
+    assert om.is_up(3)
+    om.mark_down(5)
+    assert not agg.report_failure(3, reporter=5, now=3.0)  # dead reporter
+    assert om.is_up(3)
+
+
+def test_cancel_report():
+    om = _cluster()
+    agg = FailureAggregator(om, min_reporters=2)
+    agg.report_failure(3, reporter=0, now=1.0)
+    agg.cancel_report(3, reporter=0)
+    assert agg.pending_reports() == {}
+    assert not agg.report_failure(3, reporter=1, now=2.0)
+    assert om.is_up(3)
+
+
+def test_dead_reporter_pending_filtered():
+    """A reporter that dies after reporting stops counting (review
+    regression)."""
+    om = _cluster()
+    agg = FailureAggregator(om, min_reporters=2)
+    agg.report_failure(3, reporter=5, now=1.0)
+    om.mark_down(5)
+    assert not agg.report_failure(3, reporter=1, now=2.0)
+    assert om.is_up(3)
+
+
+def test_externally_downed_target_clears_pending():
+    om = _cluster()
+    agg = FailureAggregator(om, min_reporters=2)
+    agg.report_failure(3, reporter=0, now=1.0)
+    om.mark_down(3)
+    agg.report_failure(3, reporter=1, now=2.0)
+    assert agg.pending_reports() == {}

@@ -190,3 +190,18 @@ def test_memstore_shadows_only_named_objects(monkeypatch):
         Transaction().write("c", "o3", 0, b"y").setattr("c", "o3", "a", b"b")
     )
     assert len(copies) <= 2  # o3 once (cached after), never the other 49
+
+
+def test_recovery_with_truncated_helper(payloads):
+    """A short (truncated) helper must fall back to the verified path,
+    not raise (review regression)."""
+    ecs = make_store()
+    ecs.put("obj", payloads["big"])
+    ecs.lose_shard("obj", 2)
+    ecs.stores[0].queue_transaction(
+        Transaction().truncate("ec_pool", "obj", 100)
+    )
+    ecs.recover_shard("obj", 2)
+    res = ecs.scrub("obj")
+    assert 2 not in res.missing and 2 not in res.corrupt
+    assert ecs.get("obj") == payloads["big"]
