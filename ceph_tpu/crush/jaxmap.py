@@ -5,8 +5,9 @@ over a thread pool (src/osd/OSDMapMapping.h:18-156); here the whole map
 compiles to dense arrays and ``crush_do_rule`` becomes a scalar-traced
 function vmapped over the PG batch: one device call maps a million PGs.
 
-Scope (v1): straw2 hierarchies (every bucket alg CRUSH_BUCKET_STRAW2 —
-the modern default and the 10k-OSD benchmark config), tunables with
+Scope: straw2 + uniform bucket hierarchies (the algs the hammer+
+profiles allow, minus legacy list/straw — the modern default and the
+10k-OSD benchmark config are pure straw2), tunables with
 choose_local_tries == choose_local_fallback_tries == 0 (true of every
 profile since bobtail), rule programs of [SET_*...] TAKE CHOOSE[LEAF]
 EMIT groups.  Anything else raises UnsupportedMap and callers fall back
@@ -39,6 +40,7 @@ from .hashing import _mix_inner  # noqa: E402
 from .ln import _tables as _ln_tables  # noqa: E402
 from .types import (  # noqa: E402
     CRUSH_BUCKET_STRAW2,
+    CRUSH_BUCKET_UNIFORM,
     CRUSH_ITEM_NONE,
     CRUSH_ITEM_UNDEF,
     CRUSH_RULE_CHOOSELEAF_FIRSTN,
@@ -140,13 +142,14 @@ class CompiledMap:
     integer range (2^53) covers the 2^48 fixed-point ln values.
     """
 
-    row_pack: jnp.ndarray  # (nb, 3*sz+1) f32: items | w_hi | w_lo | size
+    row_pack: jnp.ndarray  # (nb, 3*sz+3) f32: items|w_hi|w_lo|size|alg|id
     types_f: jnp.ndarray  # (nb,) f32 bucket types
     bidx_f: jnp.ndarray  # (max_neg,) f32: (-1-id) -> row, -1 for gaps
     ln_tbl1: jnp.ndarray  # (129, 4) f32: rh_hi, rh_lo, lh_hi, lh_lo
     ln_tbl2: jnp.ndarray  # (256, 2) f32: ll_hi, ll_lo
     sz: int
     nb: int
+    has_uniform: bool
     bidx: tuple  # host-side (-1-id) -> row for TAKE resolution
     max_devices: int
     tunables: tuple  # (total_tries, descend_once, vary_r, stable)
@@ -170,9 +173,10 @@ def compile_map(cmap) -> CompiledMap:
     if not cmap.buckets:
         raise UnsupportedMap("empty map")
     for b in cmap.buckets.values():
-        if b.alg != CRUSH_BUCKET_STRAW2:
+        if b.alg not in (CRUSH_BUCKET_STRAW2, CRUSH_BUCKET_UNIFORM):
             raise UnsupportedMap(
-                f"bucket {b.id} alg {b.alg}: device kernel is straw2-only"
+                f"bucket {b.id} alg {b.alg}: device kernel supports "
+                "straw2 and uniform buckets"
             )
     if cmap.choose_args:
         raise UnsupportedMap("choose_args not yet in the device kernel")
@@ -183,6 +187,8 @@ def compile_map(cmap) -> CompiledMap:
     weights = np.zeros((nb, sz), dtype=np.int64)
     sizes = np.zeros(nb, dtype=np.int64)
     types = np.zeros(nb, dtype=np.int64)
+    algs = np.zeros(nb, dtype=np.int64)
+    ids = np.zeros(nb, dtype=np.int64)
     max_neg = max(-b.id for b in cmap.buckets.values())
     bidx = np.full(max_neg, -1, dtype=np.int64)
     for row, b in enumerate(
@@ -192,9 +198,13 @@ def compile_map(cmap) -> CompiledMap:
         weights[row, : b.size] = b.item_weights
         sizes[row] = b.size
         types[row] = b.type
+        algs[row] = b.alg
+        ids[row] = b.id
         bidx[-1 - b.id] = row
         if b.size and max(abs(i) for i in b.items) >= 1 << 24:
             raise UnsupportedMap("item id magnitude >= 2^24")
+        if abs(b.id) >= 1 << 24:
+            raise UnsupportedMap("bucket id magnitude >= 2^24")
         if b.weight >= 1 << 32:
             raise UnsupportedMap("bucket weight >= 2^32")
 
@@ -208,6 +218,8 @@ def compile_map(cmap) -> CompiledMap:
             (weights >> 16).astype(np.float32),
             (weights & 0xFFFF).astype(np.float32),
             sizes[:, None].astype(np.float32),
+            algs[:, None].astype(np.float32),
+            ids[:, None].astype(np.float32),
         ],
         axis=1,
     )
@@ -226,6 +238,7 @@ def compile_map(cmap) -> CompiledMap:
         ln_tbl2=jnp.asarray(ln_tbl2),
         sz=sz,
         nb=nb,
+        has_uniform=bool((algs == CRUSH_BUCKET_UNIFORM).any()),
         bidx=tuple(int(v) for v in bidx),
         max_devices=cmap.max_devices,
         tunables=(
@@ -328,20 +341,25 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
         oh = (jnp.arange(n) == i).astype(jnp.float32)
         return jnp.matmul(oh, table, precision=HIP)
 
-    def straw2(bidx_row, x, r):
-        """One straw2 draw-argmax (mapper.c:361-384); returns
-        (item, bucket_size).
-
-        draw_i = -floor(L_i/w_i) computed in float64: L < 2^48 and
-        w < 2^32 are f64-exact, the quotient estimate is off by at most
-        one ulp, and a multiply-compare fixup restores the exact floor
-        (q*w <= L < (q+1)*w with q*w < 2^53 exact)."""
+    def load_bucket(bidx_row):
+        """One row_pack lookup -> (ids, wf, size, alg, bid)."""
         row = _lookup(bidx_row, NB, cm.row_pack)
         ids = jnp.round(row[:SZ]).astype(jnp.int32)
         wf = row[SZ : 2 * SZ].astype(jnp.float64) * 65536.0 + row[
             2 * SZ : 3 * SZ
         ].astype(jnp.float64)
         size = jnp.round(row[3 * SZ]).astype(jnp.int32)
+        alg = jnp.round(row[3 * SZ + 1]).astype(jnp.int32)
+        bid = jnp.round(row[3 * SZ + 2]).astype(jnp.int32)
+        return ids, wf, size, alg, bid
+
+    def straw2_draw(ids, wf, size, x, r):
+        """One straw2 draw-argmax (mapper.c:361-384).
+
+        draw_i = -floor(L_i/w_i) computed in float64: L < 2^48 and
+        w < 2^32 are f64-exact, the quotient estimate is off by at most
+        one ulp, and a multiply-compare fixup restores the exact floor
+        (q*w <= L < (q+1)*w with q*w < 2^53 exact)."""
         u = (
             _hash3(
                 jnp.uint32(x),
@@ -362,10 +380,55 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
             (wf > 0) & (jnp.arange(SZ) < size), -q, -jnp.inf
         )
         am = jnp.argmax(draw)
-        item = jnp.sum(
+        return jnp.sum(
             jnp.where(jnp.arange(SZ) == am, ids, 0)
         ).astype(jnp.int32)
-        return item, size
+
+    def perm_draw(ids, size, bid, x, r):
+        """Uniform bucket chooser: slot r%size of the Fisher-Yates
+        permutation seeded by hash(x, id, step) (bucket_perm_choose,
+        mapper.c:73-131 — the r=0 fast path is the p=0 step of the
+        same construction, so one loop covers both)."""
+        size1 = jnp.maximum(size, 1)
+        pr = jnp.int32(r) % size1
+        slots = jnp.arange(SZ, dtype=jnp.int32)
+
+        def body(p, perm):
+            p = jnp.int32(p)
+            active = (p <= pr) & (p < size - 1)
+            h = _hash3(jnp.uint32(x), jnp.uint32(bid), jnp.uint32(p))
+            # C reduces the unsigned hash; an int32 view would flip
+            # high hashes negative and change the residue
+            i = (
+                h.astype(jnp.int64)
+                % jnp.maximum(size1 - p, 1).astype(jnp.int64)
+            ).astype(jnp.int32)
+            idx2 = p + i
+            vp = jnp.sum(jnp.where(slots == p, perm, 0))
+            v2 = jnp.sum(jnp.where(slots == idx2, perm, 0))
+            swapped = jnp.where(
+                slots == p, v2, jnp.where(slots == idx2, vp, perm)
+            )
+            return jnp.where(active, swapped, perm).astype(jnp.int32)
+
+        perm = lax.fori_loop(0, SZ, body, slots)
+        s = jnp.sum(jnp.where(slots == pr, perm, 0))
+        return jnp.sum(jnp.where(slots == s, ids, 0)).astype(jnp.int32)
+
+    def dispatch_draw(ids, wf, size, alg, bid, x, r):
+        """crush_bucket_choose over already-loaded bucket data; the
+        perm path only compiles into maps that contain uniform
+        buckets."""
+        item = straw2_draw(ids, wf, size, x, r)
+        if cm.has_uniform:
+            uni = perm_draw(ids, size, bid, x, r)
+            item = jnp.where(alg == CRUSH_BUCKET_UNIFORM, uni, item)
+        return item
+
+    def bucket_draw(bidx_row, x, r):
+        """Load + draw; returns (item, bucket_size)."""
+        ids, wf, size, alg, bid = load_bucket(bidx_row)
+        return dispatch_draw(ids, wf, size, alg, bid, x, r), size
 
     def row_of(item):
         """Bucket row for a (negative) item; -1 if invalid."""
@@ -437,7 +500,7 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
                 sub_r = jnp.int32(0)
             r = jnp.where(in_leaf, leaf_rep + sub_r + lftotal, r_outer)
 
-            item, bsize = straw2(cur_row, x, r)
+            item, bsize = bucket_draw(cur_row, x, r)
             empty = bsize == 0
             target = jnp.where(in_leaf, 0, ttype)
             found, desc, hard_bad, nrow = classify(item, target)
@@ -564,14 +627,30 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
 
         def body(st):
             (done, slot, left, ftotal, mode, cur_row, domain, lftotal,
-             depth, out, out2) = st
+             depth, parent_r, out, out2) = st
             in_leaf = mode == LEAF
-            r_outer = slot + numrep * ftotal
+            ids, wf, bsize, alg, bid = load_bucket(cur_row)
+            # uniform buckets whose size divides numrep advance r with
+            # stride numrep+1 (mapper.c:722-728) — per descent level
+            if cm.has_uniform:
+                stride = jnp.where(
+                    (alg == CRUSH_BUCKET_UNIFORM)
+                    & (bsize > 0)
+                    & (bsize % numrep == 0),
+                    numrep + 1,
+                    numrep,
+                )
+            else:
+                stride = jnp.int32(numrep)
+            # parent_r freezes the outer r at domain-choice time for
+            # the chooseleaf recursion (its nested call re-bases on it)
             r = jnp.where(
-                in_leaf, slot + r_outer + numrep * lftotal, r_outer
+                in_leaf,
+                slot + parent_r + stride * lftotal,
+                slot + stride * ftotal,
             )
 
-            item, bsize = straw2(cur_row, x, r)
+            item = dispatch_draw(ids, wf, bsize, alg, bid, x, r)
             empty = bsize == 0
             target = jnp.where(in_leaf, 0, ttype)
             found, desc, hard_bad, nrow = classify(item, target)
@@ -648,23 +727,24 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
                 enter_leaf, 0, jnp.where(l_retry, lftotal + 1, lftotal)
             )
             new_depth = jnp.where(o_desc | l_desc, depth + 1, 0)
+            new_parent_r = jnp.where(enter_leaf, r, parent_r)
             return (
                 new_done, new_slot, new_left, new_ftotal.astype(jnp.int32),
                 new_mode, new_row, new_domain,
                 new_lftotal.astype(jnp.int32), new_depth.astype(jnp.int32),
-                out, out2,
+                new_parent_r.astype(jnp.int32), out, out2,
             )
 
         init = (
             jnp.bool_(R == 0) | jnp.bool_(tries <= 0),
             jnp.int32(0), jnp.int32(R), jnp.int32(0),
             OUTER, jnp.int32(take_row), jnp.int32(0), jnp.int32(0),
-            jnp.int32(0),
+            jnp.int32(0), jnp.int32(0),
             jnp.full((R,), UNDEF, dtype=jnp.int32),
             jnp.full((R,), UNDEF, dtype=jnp.int32),
         )
         st = lax.while_loop(cond, body, init)
-        out, out2 = st[9], st[10]
+        out, out2 = st[10], st[11]
         out = jnp.where(out == UNDEF, NONE, out)
         out2 = jnp.where(out2 == UNDEF, NONE, out2)
         return (out2 if leaf else out), jnp.int32(R)

@@ -54,7 +54,7 @@ class HeartbeatTracker:
 
 @dataclass
 class _Pending:
-    reporters: dict[int, float] = field(default_factory=dict)
+    reporters: set[int] = field(default_factory=set)
 
 
 class FailureAggregator:
@@ -84,10 +84,10 @@ class FailureAggregator:
         if not self.osdmap.is_up(reporter):
             return False  # dead reporters don't count
         p = self._pending.setdefault(target, _Pending())
-        p.reporters[reporter] = now
+        p.reporters.add(reporter)
         # reporters that died since reporting no longer count
         p.reporters = {
-            r: t for r, t in p.reporters.items() if self.osdmap.is_up(r)
+            r for r in p.reporters if self.osdmap.is_up(r)
         }
         dout(
             "osd",
@@ -105,7 +105,7 @@ class FailureAggregator:
         again withdraws its report."""
         p = self._pending.get(target)
         if p:
-            p.reporters.pop(reporter, None)
+            p.reporters.discard(reporter)
             if not p.reporters:
                 del self._pending[target]
 

@@ -188,9 +188,9 @@ class ECStore:
             rebuilt, read_bytes = self._repair_minimum(
                 name, meta, shard, available
             )
-        except (ErasureCodeError, StoreError, ValueError):
-            # ValueError: a truncated helper shard breaks the array
-            # shapes; the verified path below filters it out by crc
+        except (ErasureCodeError, StoreError):
+            # e.g. a truncated helper (length-checked in
+            # _repair_minimum); the verified path filters it by crc
             rebuilt = None
         if (
             rebuilt is None
@@ -221,7 +221,15 @@ class ECStore:
         rebuilt-shard crc)."""
         minimum = self.ec.minimum_to_decode({shard}, available)
         chunk_len = self.sinfo.chunk_size
-        shard_len = self.stores[next(iter(minimum))].stat(self.cid, name)
+        lengths = {
+            h: self.stores[h].stat(self.cid, name) for h in minimum
+        }
+        shard_len = max(lengths.values())
+        short = [h for h, n in lengths.items() if n != shard_len]
+        if short or shard_len % chunk_len:
+            raise StoreError(
+                f"helper shards truncated or misaligned: {short}"
+            )
         sub_count = self.ec.get_sub_chunk_count()
         read_bytes = 0
         if sub_count > 1 and any(

@@ -273,3 +273,41 @@ def test_device_crush_ln_exact_full_domain():
         jax.jit(lambda u: _crush_ln_f64(u, cm.ln_tbl1, cm.ln_tbl2))(us)
     ).astype(np.int64)
     np.testing.assert_array_equal(got, ln_ref(us))
+
+
+def test_uniform_buckets_match_oracle():
+    """Uniform (perm-choose) buckets on device vs the oracle — flat
+    uniform root and uniform hosts under a straw2 root, including the
+    size-divides-numrep indep stride (mapper.c:722-728)."""
+    # flat uniform root over 8 osds
+    m1 = CrushMap(tunables=JEWEL)
+    from ceph_tpu.crush.types import CRUSH_BUCKET_UNIFORM
+
+    root = m1.add_bucket(
+        CRUSH_BUCKET_UNIFORM, 3, list(range(8)), [0x18000] * 8
+    )
+    _add_two_rules(m1, root, 0)
+    # uniform hosts (size 4, divides numrep for some sizes) under straw2
+    m2 = CrushMap(tunables=JEWEL)
+    hosts = []
+    for h in range(6):
+        items = [h * 4 + i for i in range(4)]
+        hosts.append(
+            m2.add_bucket(CRUSH_BUCKET_UNIFORM, 1, items, [0x10000] * 4)
+        )
+    hw = [m2.buckets[b].weight for b in hosts]
+    root2 = m2.add_bucket(CRUSH_BUCKET_STRAW2, 3, hosts, hw)
+    _add_two_rules(m2, root2, 1)
+
+    for m in (m1, m2):
+        cm = compile_map(m)
+        xs = np.arange(192, dtype=np.int32)
+        for rule in (0, 1):
+            for result_max in (2, 4):
+                wv = mixed_weight_vector(m.max_devices, seed=13)
+                got, counts = batch_do_rule(cm, rule, xs, result_max, wv)
+                for x in range(192):
+                    expect = m.do_rule(rule, x, result_max, list(wv))
+                    assert (
+                        np.asarray(got)[x, : counts[x]].tolist() == expect
+                    ), (rule, result_max, x)
