@@ -54,27 +54,35 @@ def measure_device(matrix, batch: int, iters: int) -> float:
             acc = out.sum(dtype=jnp.uint8)
         return acc
 
-    times = {}
-    for b in (batch, batch * 4):
-        stripes = jax.device_put(
+    small, big = batch, batch * 8
+    fns = {}
+    data = {}
+    for b in (small, big):
+        data[b] = jax.device_put(
             rng.integers(0, 256, size=(b, K, CHUNK), dtype=np.uint8)
         )
-        fn = jax.jit(chained)
-        int(fn(stripes))  # compile + warm
-        best = min(
-            _timed(lambda: int(fn(stripes))) for _ in range(3)
+        fns[b] = jax.jit(chained)
+        int(fns[b](data[b]))  # compile + warm
+    # interleaved pairs; median delta resists the dispatch/tunnel
+    # jitter that dwarfs any single measurement
+    deltas = []
+    for trial in range(5):
+        t_small = _timed(lambda: int(fns[small](data[small])))
+        t_big = _timed(lambda: int(fns[big](data[big])))
+        deltas.append(t_big - t_small)
+        _log(
+            f"device[{jax.devices()[0].platform}] trial {trial}: "
+            f"{iters}x{small}x1MB {t_small * 1000:.1f}ms, "
+            f"{iters}x{big}x1MB {t_big * 1000:.1f}ms"
         )
-        times[b] = best
-        _log(f"device[{jax.devices()[0].platform}] chained {iters}x"
-             f"{b}x{OBJECT_SIZE >> 20}MB: {best * 1000:.1f}ms")
-    extra_bytes = iters * (batch * 4 - batch) * K * CHUNK
-    delta = times[batch * 4] - times[batch]
+    delta = sorted(deltas)[len(deltas) // 2]
+    extra_bytes = iters * (big - small) * K * CHUNK
     if delta <= 0:
-        # overhead swamped the size delta; fall back to the total-time
-        # figure for the larger batch (conservative)
-        _log("warning: non-positive timing delta; using total time")
-        total = iters * batch * 4 * K * CHUNK
-        gbs = total / times[batch * 4] / 2**30
+        _log("warning: non-positive median delta; using total time")
+        total = iters * big * K * CHUNK
+        gbs = total / min(
+            _timed(lambda: int(fns[big](data[big]))) for _ in range(3)
+        ) / 2**30
     else:
         gbs = extra_bytes / delta / 2**30
     _log(f"device marginal: {gbs:.3f} GB/s input")

@@ -150,6 +150,7 @@ class CompiledMap:
     sz: int
     nb: int
     has_uniform: bool
+    uniform_sz: int  # max uniform-bucket size (perm loop bound)
     bidx: tuple  # host-side (-1-id) -> row for TAKE resolution
     max_devices: int
     tunables: tuple  # (total_tries, descend_once, vary_r, stable)
@@ -239,6 +240,11 @@ def compile_map(cmap) -> CompiledMap:
         sz=sz,
         nb=nb,
         has_uniform=bool((algs == CRUSH_BUCKET_UNIFORM).any()),
+        uniform_sz=int(
+            sizes[algs == CRUSH_BUCKET_UNIFORM].max()
+        )
+        if (algs == CRUSH_BUCKET_UNIFORM).any()
+        else 0,
         bidx=tuple(int(v) for v in bidx),
         max_devices=cmap.max_devices,
         tunables=(
@@ -316,7 +322,8 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
     """Build the scalar-traced do_rule for one (map, rule, result_max).
 
     Each chooser is ONE flat while_loop whose every iteration performs
-    exactly one straw2 bucket draw; descent levels, retry-descents and
+    exactly one bucket draw (straw2, plus a perm-choose path compiled
+    in only for maps containing uniform buckets); descent levels, retry-descents and
     chooseleaf recursion are a mode register, not nested loops.  Under
     vmap all lanes advance together, so wall-clock per batch is the
     *maximum lane's total draw count* (typically depth+1 draws per
@@ -391,7 +398,12 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
         same construction, so one loop covers both)."""
         size1 = jnp.maximum(size, 1)
         pr = jnp.int32(r) % size1
-        slots = jnp.arange(SZ, dtype=jnp.int32)
+        # uniform buckets never exceed uniform_sz, so the FY loop and
+        # slot vector are bounded by it, not the map-wide max bucket
+        # size (a wide straw2 root would otherwise make every draw
+        # quadratic in SZ)
+        usz = max(cm.uniform_sz, 1)
+        slots = jnp.arange(usz, dtype=jnp.int32)
 
         def body(p, perm):
             p = jnp.int32(p)
@@ -411,9 +423,11 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
             )
             return jnp.where(active, swapped, perm).astype(jnp.int32)
 
-        perm = lax.fori_loop(0, SZ, body, slots)
+        perm = lax.fori_loop(0, usz, body, slots)
         s = jnp.sum(jnp.where(slots == pr, perm, 0))
-        return jnp.sum(jnp.where(slots == s, ids, 0)).astype(jnp.int32)
+        return jnp.sum(
+            jnp.where(jnp.arange(SZ) == s, ids, 0)
+        ).astype(jnp.int32)
 
     def dispatch_draw(ids, wf, size, alg, bid, x, r):
         """crush_bucket_choose over already-loaded bucket data; the
