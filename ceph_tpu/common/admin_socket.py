@@ -92,6 +92,14 @@ class AdminSocket:
             "perf dump", lambda args: self.perf.dump(),
             "dump perfcounters",
         )
+
+        def _perf_reset(args):
+            self.perf.reset()
+            return {"success": True}
+
+        self.register_command(
+            "perf reset", _perf_reset, "zero all perfcounters"
+        )
         self.register_command(
             "config show", lambda args: self.config.show_config(),
             "show effective config",

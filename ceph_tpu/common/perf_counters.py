@@ -188,3 +188,9 @@ class PerfCountersCollection:
     def dump(self) -> dict:
         with self._lock:
             return {name: pc.dump() for name, pc in self._sets.items()}
+
+    def reset(self) -> None:
+        """Zero every registered set (the `perf reset all` builtin)."""
+        with self._lock:
+            for pc in self._sets.values():
+                pc.reset()

@@ -124,6 +124,15 @@ class CrushMap:
     )
     item_names: dict[int, str] = field(default_factory=dict)
     rule_names: dict[int, str] = field(default_factory=dict)
+    # Bumped by every mutator; consumers that compile the map to dense
+    # device arrays (osd/mapping.py) key their cache on this so a
+    # topology or weight change invalidates the compiled form.
+    mutation: int = 0
+
+    def touch(self) -> None:
+        """Record a structural/weight mutation (invalidates compiled
+        caches).  Call after mutating buckets/rules/tunables directly."""
+        self.mutation += 1
 
     def _name_to_item(self, name: str) -> int:
         for item, n in self.item_names.items():
@@ -183,6 +192,7 @@ class CrushMap:
                 weights, self.tunables.straw_calc_version
             )
         self.buckets[id] = b
+        self.touch()
         for item in items:
             if item >= 0:
                 self.max_devices = max(self.max_devices, item + 1)
@@ -198,6 +208,7 @@ class CrushMap:
         assert self.rules[ruleno] is None
         self.rules[ruleno] = rule
         rule.ruleset = ruleno
+        self.touch()
         return ruleno
 
     def add_simple_rule(

@@ -291,11 +291,17 @@ class OSDMapMapping:
 
 
 def _compiled(crush_map):
-    """Per-CrushMap compiled-array cache keyed by identity."""
-    cm = getattr(crush_map, "_jax_compiled", None)
-    if cm is None:
+    """Per-CrushMap compiled-array cache, invalidated on mutation.
+
+    Keyed on ``CrushMap.mutation`` (bumped by every builder mutator /
+    ``touch()``) so editing the map after a batched mapping pass
+    recompiles the dense arrays instead of silently reusing stale
+    topology/weights."""
+    gen = getattr(crush_map, "mutation", 0)
+    cached = getattr(crush_map, "_jax_compiled", None)
+    if cached is None or cached[0] != gen:
         from ..crush import jaxmap
 
-        cm = jaxmap.compile_map(crush_map)
-        crush_map._jax_compiled = cm
-    return cm
+        cached = (gen, jaxmap.compile_map(crush_map))
+        crush_map._jax_compiled = cached
+    return cached[1]

@@ -92,3 +92,10 @@ def test_op_history_bounded(sock):
     slow = tracker.dump_historic_slow_ops()
     durations = [o["duration"] for o in slow["ops"]]
     assert durations == sorted(durations, reverse=True)
+
+
+def test_perf_reset_builtin(sock):
+    assert admin_command(sock.path, "perf dump")["ok"]["ec"]["encodes"] == 5
+    out = admin_command(sock.path, "perf reset")
+    assert out["ok"] == {"success": True}
+    assert admin_command(sock.path, "perf dump")["ok"]["ec"]["encodes"] == 0

@@ -139,3 +139,46 @@ def test_affinity_zero_never_primary_unless_sole(cluster):
         if upp == 0 and len(up) > 1:
             count0 += 1
     assert count0 == 0  # affinity 0 ⇒ rejected whenever alternatives exist
+
+
+def test_compiled_cache_invalidated_on_map_mutation():
+    """Mutating the CrushMap after a batched update must recompile the
+    dense arrays (mapping.py _compiled keys on CrushMap.mutation), so
+    placements track the new topology instead of the stale cache."""
+    m = CrushMap(tunables=JEWEL)
+    h0 = m.add_bucket(
+        CRUSH_BUCKET_STRAW2, 1, [0, 1], [0x10000] * 2, name="h0"
+    )
+    root = m.add_bucket(
+        CRUSH_BUCKET_STRAW2, 3, [h0], [m.buckets[h0].weight], name="root"
+    )
+    rep = m.add_simple_rule("rep", "root", "osd", mode="firstn")
+    om = OSDMap.build(m, 2)
+    om.add_pool(
+        PgPool(pool_id=1, type=PG_POOL_TYPE_REPLICATED, size=2,
+               pg_num=16, crush_rule=rep)
+    )
+    mapping = OSDMapMapping()
+    mapping.update(om)
+
+    # grow the cluster: a second host with two new devices
+    h1 = m.add_bucket(
+        CRUSH_BUCKET_STRAW2, 1, [2, 3], [0x10000] * 2, name="h1"
+    )
+    m.buckets[root].items.append(h1)
+    m.buckets[root].item_weights.append(m.buckets[h1].weight)
+    m.buckets[root].weight += m.buckets[h1].weight
+    m.touch()
+    om.max_osd = 4
+    om.osd_exists += [True, True]
+    om.osd_up += [True, True]
+    om.osd_weight += [0x10000, 0x10000]
+
+    mapping.update(om)
+    seen = set()
+    for ps in range(16):
+        up, upp, acting, actp = om.pg_to_up_acting_osds(1, ps)
+        gup, _, gact, _ = mapping.get(1, ps)
+        assert _norm(gup) == _norm(up), ps
+        seen.update(_norm(gup))
+    assert seen & {2, 3}, "new devices never mapped — stale compile"
