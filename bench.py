@@ -1,14 +1,21 @@
-"""Round benchmark: EC encode throughput at the BASELINE.md headline config.
+"""Round benchmark: the two BASELINE.md headline configs.
 
-Mirrors ``ceph_erasure_code_benchmark --workload encode --parameter k=8
---parameter m=3`` with 1MB stripes (src/test/erasure-code/
-ceph_erasure_code_benchmark.cc:156-186): GB/s of *input* bytes encoded.
+1. EC encode throughput, ``ceph_erasure_code_benchmark --workload encode
+   --parameter k=8 --parameter m=3`` with 1MB stripes
+   (src/test/erasure-code/ceph_erasure_code_benchmark.cc:156-186):
+   GB/s of *input* bytes encoded.
+2. CRUSH mapping throughput, BASELINE config #5: 1M PGs mapped through a
+   10k-OSD straw2 hierarchy (``crushtool --test`` /
+   ``osdmaptool --test-map-pgs`` surface, src/crush/CrushTester.cc,
+   src/tools/osdmaptool.cc:147-218): mappings/sec.
 
-The reference publishes no absolute numbers (BASELINE.md), so
-``vs_baseline`` is measured live: the same encode through the numpy
-region-math oracle on this host's CPU stands in for the
-jerasure/gf-complete table-lookup path, and the reported ratio is
-device GB/s / CPU GB/s.
+``vs_baseline`` is stated honestly: the reference publishes no absolute
+numbers, and this host cannot run real jerasure/ISA-L, so the EC ratio
+is computed against an ISA-L-class estimate (~7.5 GB/s for one SIMD CPU
+core — real jerasure/ISA-L does roughly 5-10 GB/s/core on this config),
+NOT against the repo's own single-threaded numpy oracle (which is
+~40x slower than ISA-L and would overstate the win).  Both the
+measured numpy-oracle rate and the estimate are reported alongside.
 
 Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
 """
@@ -110,22 +117,99 @@ def measure_cpu(matrix, iters: int) -> float:
     return total / dt / 2**30
 
 
+# ISA-L-class single-core RS encode rate for k=8,m=3 @1MB: real SIMD
+# implementations land in the 5-10 GB/s range; use the midpoint as the
+# honest denominator (the numpy oracle is ~40x slower than that and
+# would be a strawman).
+ISAL_CLASS_GBPS = 7.5
+
+CRUSH_OSDS = 10_000
+CRUSH_PER_HOST = 40
+CRUSH_HOSTS_PER_RACK = 25
+CRUSH_PGS = 1 << 20
+CRUSH_REP = 3
+CRUSH_DEVICE_BATCH = 1 << 17  # one compiled shape, 8 calls per pass
+
+
+def measure_crush() -> dict:
+    """BASELINE #5: 1M-PG remap over a 10k-OSD straw2 hierarchy.
+
+    The device kernel maps the PG batch in fixed-shape chunks (one
+    compile); per-pass wall time includes every device call and the
+    host-side result materialization, so it is directly comparable to
+    osdmaptool's end-to-end figure.  The CPU oracle rate is measured on
+    a 2048-PG sample of the same map/rule (a full 1M-PG oracle pass
+    would take ~1h in pure Python).
+    """
+    from ceph_tpu.crush import jaxmap
+    from ceph_tpu.tools.crushtool import build_hierarchy
+
+    m = build_hierarchy(CRUSH_OSDS, CRUSH_PER_HOST, CRUSH_HOSTS_PER_RACK)
+    rule = 0  # replicated firstn over hosts
+    cm = jaxmap.compile_map(m)
+
+    t0 = time.perf_counter()
+    xs0 = np.arange(CRUSH_DEVICE_BATCH, dtype=np.int64)
+    res, counts = jaxmap.batch_do_rule(cm, rule, xs0, CRUSH_REP)
+    np.asarray(res)
+    _log(f"crush compile+first batch: {time.perf_counter() - t0:.1f}s")
+
+    def one_pass():
+        out = []
+        for lo in range(0, CRUSH_PGS, CRUSH_DEVICE_BATCH):
+            xs = np.arange(lo, lo + CRUSH_DEVICE_BATCH, dtype=np.int64)
+            r, c = jaxmap.batch_do_rule(cm, rule, xs, CRUSH_REP)
+            out.append((np.asarray(r), np.asarray(c)))
+        return out
+
+    one_pass()  # warm every dispatch path
+    times = [_timed(one_pass) for _ in range(3)]
+    dt = sorted(times)[len(times) // 2]
+    dev_rate = CRUSH_PGS / dt
+    _log(
+        f"crush device: {CRUSH_PGS} mappings in {dt:.3f}s = "
+        f"{dev_rate:,.0f} mappings/s"
+    )
+
+    sample = 2048
+    t0 = time.perf_counter()
+    for x in range(sample):
+        m.do_rule(rule, x, CRUSH_REP)
+    oracle_rate = sample / (time.perf_counter() - t0)
+    _log(f"crush cpu oracle: {oracle_rate:,.0f} mappings/s ({sample} sample)")
+    return {
+        "crush_mappings_per_sec": round(dev_rate),
+        "crush_config": (
+            f"{CRUSH_OSDS} osds straw2 (hosts of {CRUSH_PER_HOST}, racks "
+            f"of {CRUSH_HOSTS_PER_RACK}), {CRUSH_PGS} PGs, firstn "
+            f"num_rep={CRUSH_REP}"
+        ),
+        "crush_oracle_mappings_per_sec": round(oracle_rate),
+        "crush_vs_oracle": round(dev_rate / oracle_rate, 2),
+    }
+
+
 def main() -> None:
     from ceph_tpu import gf
 
     matrix = gf.reed_sol_vandermonde_coding_matrix(K, M, W)
     gbs = measure_device(matrix, batch=32, iters=10)
     cpu = measure_cpu(matrix, iters=8)
-    print(
-        json.dumps(
-            {
-                "metric": "ec_encode_k8m3_1M_GBps",
-                "value": round(gbs, 3),
-                "unit": "GB/s",
-                "vs_baseline": round(gbs / cpu, 2),
-            }
-        )
-    )
+    crush = measure_crush()
+    out = {
+        "metric": "ec_encode_k8m3_1M_GBps",
+        "value": round(gbs, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbs / ISAL_CLASS_GBPS, 2),
+        "baseline_note": (
+            f"vs ISA-L-class ~{ISAL_CLASS_GBPS} GB/s/core estimate "
+            "(real jerasure/ISA-L: ~5-10 GB/s/core; reference publishes "
+            "no numbers); measured numpy oracle "
+            f"{cpu:.3f} GB/s (x{gbs / cpu:.0f})"
+        ),
+    }
+    out.update(crush)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
