@@ -8,7 +8,7 @@ pool (the OSDMapMapping/ParallelPGMapper replacement,
 src/osd/OSDMapMapping.h:18-156).
 """
 
-from .osdmap import OSDMap, PgPool
+from .osdmap import Incremental, OSDMap, PgPool
 from .mapping import OSDMapMapping
 
-__all__ = ["OSDMap", "OSDMapMapping", "PgPool"]
+__all__ = ["Incremental", "OSDMap", "OSDMapMapping", "PgPool"]
