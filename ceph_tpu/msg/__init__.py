@@ -1,0 +1,51 @@
+"""Messenger — the framework's wire layer (src/msg/, src/msg/async/).
+
+The reference's Messenger is a connection-oriented dispatcher fabric:
+daemons create a messenger, bind, register Dispatchers, and exchange
+typed Messages over framed protocols (ProtocolV2: banner, segmented
+frames, crc or secure mode).  This package re-renders that contract
+small and async-native:
+
+- ``Message`` subclasses declare a type id + payload encode/decode
+  (the ECMsgTypes/MOSDPing/MOSDMap analog, src/osd/ECMsgTypes.h).
+- ``Messenger`` owns an asyncio loop on a background thread, binds a
+  TCP listener, and dispatches inbound messages to registered
+  ``Dispatcher``s (Messenger::add_dispatcher_head, ms_dispatch).
+- Frames are length-prefixed with crc32c over header and payload
+  (ProtocolV2 crc mode; secure mode is out of scope — transport
+  security would wrap the socket, not the frame format).
+
+TPU note: this layer is deliberately host-only CPU code.  Bulk data
+between chips rides XLA collectives inside jitted programs (SURVEY.md
+§5.8); the messenger carries control-plane and shard-IO traffic
+between *processes/hosts*, exactly the role the reference's
+AsyncMessenger plays beneath the OSDs.
+"""
+
+from .message import (
+    MECSubRead,
+    MECSubReadReply,
+    MECSubWrite,
+    MECSubWriteReply,
+    MOSDMap,
+    MPing,
+    Message,
+    MessageError,
+    register_message,
+)
+from .messenger import Connection, Dispatcher, Messenger
+
+__all__ = [
+    "Connection",
+    "Dispatcher",
+    "MECSubRead",
+    "MECSubReadReply",
+    "MECSubWrite",
+    "MECSubWriteReply",
+    "MOSDMap",
+    "MPing",
+    "Message",
+    "MessageError",
+    "Messenger",
+    "register_message",
+]

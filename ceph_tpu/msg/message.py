@@ -1,0 +1,402 @@
+"""Typed messages + frame codec (the ECMsgTypes / MOSDPing / MOSDMap
+roles, src/osd/ECMsgTypes.{h,cc}, src/messages/MOSDPing.h,
+src/messages/MOSDMap.h) over the framework's versioned encoding.
+
+Frame layout (ProtocolV2 crc-mode analog, src/msg/async/frames_v2.h):
+
+    u32 magic | u16 type | u16 reserved | u64 tid | u32 payload_len
+    u32 header_crc (crc32c over the 20 header bytes)
+    payload bytes
+    u32 payload_crc
+
+Every message carries ``tid`` (transaction id) so replies pair with
+requests across the connection, like the reference's sub-op tids.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from ..common.encoding import Decoder, Encoder
+from ..native import ceph_crc32c
+from ..store.objectstore import Transaction
+
+FRAME_MAGIC = 0x43545546  # "CTUF"
+_HEADER = struct.Struct("<IHHQI")
+
+
+class MessageError(Exception):
+    pass
+
+
+_REGISTRY: dict[int, type["Message"]] = {}
+
+
+def register_message(cls):
+    """Class decorator: register a Message subclass by its TYPE id
+    (the ceph_msg_type dispatch table role)."""
+    if cls.TYPE in _REGISTRY:
+        raise ValueError(f"message type {cls.TYPE} already registered")
+    _REGISTRY[cls.TYPE] = cls
+    return cls
+
+
+@dataclass
+class Message:
+    """Base: subclasses set TYPE and implement encode_payload/
+    decode_payload.  ``tid`` pairs replies with requests."""
+
+    TYPE = 0
+    tid: int = 0
+
+    def encode_payload(self, e: Encoder) -> None:  # pragma: no cover
+        pass
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "Message":
+        return cls()
+
+    # -- frame codec -------------------------------------------------------
+    def to_frame(self) -> bytes:
+        e = Encoder()
+        self.encode_payload(e)
+        payload = e.getvalue()
+        header = _HEADER.pack(
+            FRAME_MAGIC, self.TYPE, 0, self.tid, len(payload)
+        )
+        return b"".join(
+            (
+                header,
+                ceph_crc32c(0, header).to_bytes(4, "little"),
+                payload,
+                ceph_crc32c(0, payload).to_bytes(4, "little"),
+            )
+        )
+
+    @staticmethod
+    def parse_header(buf: bytes) -> tuple[int, int, int]:
+        """(type, tid, payload_len) from the 24-byte header block;
+        raises MessageError on magic/crc mismatch."""
+        if len(buf) != _HEADER.size + 4:
+            raise MessageError("short header")
+        magic, mtype, _res, tid, plen = _HEADER.unpack(
+            buf[: _HEADER.size]
+        )
+        if magic != FRAME_MAGIC:
+            raise MessageError(f"bad magic {magic:#x}")
+        crc = int.from_bytes(buf[_HEADER.size :], "little")
+        if ceph_crc32c(0, buf[: _HEADER.size]) != crc:
+            raise MessageError("header crc mismatch")
+        return mtype, tid, plen
+
+    @staticmethod
+    def from_payload(mtype: int, tid: int, payload: bytes, crc: int):
+        if ceph_crc32c(0, payload) != crc:
+            raise MessageError("payload crc mismatch")
+        cls = _REGISTRY.get(mtype)
+        if cls is None:
+            raise MessageError(f"unknown message type {mtype}")
+        msg = cls.decode_payload(Decoder(payload))
+        msg.tid = tid
+        return msg
+
+    HEADER_SIZE = _HEADER.size + 4
+
+
+# -- transaction / op serialization ----------------------------------------
+
+_TXN_OPS = {
+    "mkcoll": "cs",
+    "touch": "css",
+    "write": "cssqb",
+    "truncate": "cssq",
+    "setattr": "csssb",
+    "rmattr": "csss",
+    "remove": "css",
+    "rmcoll": "cs",
+}
+# field codes: c=opcode string, s=str, q=int, b=bytes
+_OPCODES = {name: i for i, name in enumerate(sorted(_TXN_OPS))}
+_OPNAMES = {i: name for name, i in _OPCODES.items()}
+
+
+def encode_transaction(e: Encoder, txn: Transaction) -> None:
+    """Serialize the ordered op list (Transaction.h op encoding role)."""
+    e.u32(len(txn.ops))
+    for op in txn.ops:
+        name = op[0]
+        spec = _TXN_OPS[name]
+        e.u8(_OPCODES[name])
+        for kind, val in zip(spec[1:], op[1:]):
+            if kind == "s":
+                e.string(val if val is not None else "")
+            elif kind == "q":
+                e.s64(val)
+            elif kind == "b":
+                e.bytes(val)
+
+
+def decode_transaction(d: Decoder) -> Transaction:
+    txn = Transaction()
+    for _ in range(d.u32()):
+        name = _OPNAMES[d.u8()]
+        spec = _TXN_OPS[name]
+        args = []
+        for kind in spec[1:]:
+            if kind == "s":
+                args.append(d.string())
+            elif kind == "q":
+                args.append(d.s64())
+            elif kind == "b":
+                args.append(d.bytes())
+        if name in ("mkcoll", "rmcoll"):
+            args = args[:1]  # stored as (op, cid, None)
+            txn.ops.append((name, args[0], None))
+        else:
+            txn.ops.append((name, *args))
+    return txn
+
+
+# -- concrete messages -----------------------------------------------------
+
+
+@register_message
+@dataclass
+class MPing(Message):
+    """Heartbeat (MOSDPing): PING or PING_REPLY with sender id and a
+    timestamp echoed back for rtt accounting."""
+
+    TYPE = 1
+    from_osd: int = 0
+    stamp: float = 0.0
+    is_reply: bool = False
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.from_osd).f64(self.stamp).bool(self.is_reply)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MPing":
+        return cls(
+            from_osd=d.s32(), stamp=d.f64(), is_reply=d.bool()
+        )
+
+
+@register_message
+@dataclass
+class MECSubWrite(Message):
+    """Primary → shard sub-write (ECSubWrite, src/osd/ECMsgTypes.h:37):
+    one object-store transaction to apply atomically, tagged with the
+    sender and the map epoch it was planned under."""
+
+    TYPE = 2
+    from_osd: int = 0
+    epoch: int = 0
+    txn: Transaction = field(default_factory=Transaction)
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.from_osd).u32(self.epoch)
+        encode_transaction(e, self.txn)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MECSubWrite":
+        return cls(
+            from_osd=d.s32(), epoch=d.u32(), txn=decode_transaction(d)
+        )
+
+
+@register_message
+@dataclass
+class MECSubWriteReply(Message):
+    """Shard → primary commit ack (ECSubWriteReply)."""
+
+    TYPE = 3
+    from_osd: int = 0
+    ok: bool = True
+    error: str = ""
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.from_osd).bool(self.ok).string(self.error)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MECSubWriteReply":
+        return cls(from_osd=d.s32(), ok=d.bool(), error=d.string())
+
+
+# read op kinds (the shard-side handle_sub_read switch)
+READ_DATA = 0  # (cid, oid, off, len) -> bytes
+READ_ATTR = 1  # (cid, oid, attr) -> bytes
+READ_STAT = 2  # (cid, oid) -> size
+READ_EXISTS = 3  # (cid, oid) -> bool
+READ_LIST = 4  # (cid,) -> [oid]
+
+
+@register_message
+@dataclass
+class MECSubRead(Message):
+    """Primary → shard sub-read (ECSubRead, src/osd/ECMsgTypes.h:96):
+    a batch of read ops [(kind, cid, oid, arg1, arg2)]."""
+
+    TYPE = 4
+    from_osd: int = 0
+    ops: list[tuple] = field(default_factory=list)
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.from_osd)
+        e.u32(len(self.ops))
+        for kind, cid, oid, a1, a2 in self.ops:
+            e.u8(kind).string(cid).string(oid).u64(a1).string(a2)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MECSubRead":
+        msg = cls(from_osd=d.s32())
+        for _ in range(d.u32()):
+            msg.ops.append(
+                (d.u8(), d.string(), d.string(), d.u64(), d.string())
+            )
+        return msg
+
+
+@register_message
+@dataclass
+class MECSubReadReply(Message):
+    """Shard → primary read results (ECSubReadReply): per-op
+    (ok, bytes) pairs; failed ops carry the error text."""
+
+    TYPE = 5
+    from_osd: int = 0
+    results: list[tuple[bool, bytes]] = field(default_factory=list)
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.from_osd)
+        e.u32(len(self.results))
+        for ok, data in self.results:
+            e.bool(ok).bytes(data)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MECSubReadReply":
+        msg = cls(from_osd=d.s32())
+        for _ in range(d.u32()):
+            msg.results.append((d.bool(), d.bytes()))
+        return msg
+
+
+@register_message
+@dataclass
+class MOSDMap(Message):
+    """Map distribution (MOSDMap): full map blob and/or a run of
+    incremental blobs, by epoch."""
+
+    TYPE = 6
+    full: bytes = b""  # OSDMap.encode() or empty
+    incrementals: list[bytes] = field(default_factory=list)
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.bytes(self.full)
+        e.list(self.incrementals, lambda e2, b: e2.bytes(b))
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MOSDMap":
+        return cls(
+            full=d.bytes(),
+            incrementals=d.list(lambda d2: d2.bytes()),
+        )
+
+
+@register_message
+@dataclass
+class MMonSubscribe(Message):
+    """Client → mon map subscription (MonClient subscribe flow,
+    src/mon/MonClient.cc): "send me osdmaps starting at start_epoch"."""
+
+    TYPE = 7
+    what: str = "osdmap"
+    start_epoch: int = 0  # 0 = send the full current map
+    from_osd: int = -1
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.what).u32(self.start_epoch).s32(self.from_osd)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MMonSubscribe":
+        return cls(
+            what=d.string(), start_epoch=d.u32(), from_osd=d.s32()
+        )
+
+
+@register_message
+@dataclass
+class MOSDFailure(Message):
+    """OSD → mon failure report (MOSDFailure; OSD::send_failures,
+    src/osd/OSD.cc:5889).  ``failed_for`` seconds of silence; a report
+    with failed_for < 0 withdraws a previous report (the recovery
+    cancel path)."""
+
+    TYPE = 8
+    target: int = -1
+    reporter: int = -1
+    failed_for: float = 0.0
+    epoch: int = 0
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.target).s32(self.reporter)
+        e.f64(self.failed_for).u32(self.epoch)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MOSDFailure":
+        return cls(
+            target=d.s32(), reporter=d.s32(),
+            failed_for=d.f64(), epoch=d.u32(),
+        )
+
+
+@register_message
+@dataclass
+class MMonCommand(Message):
+    """CLI → mon command (MMonCommand: the `ceph` CLI speaks JSON
+    command dicts per src/mon/MonCommands.h)."""
+
+    TYPE = 9
+    cmd: str = "{}"  # JSON dict, e.g. {"prefix": "osd pool create", ...}
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.cmd)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MMonCommand":
+        return cls(cmd=d.string())
+
+
+@register_message
+@dataclass
+class MMonCommandReply(Message):
+    """Mon → CLI reply: rc + human text + JSON payload."""
+
+    TYPE = 10
+    rc: int = 0
+    outs: str = ""
+    outb: str = ""  # JSON
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.rc).string(self.outs).string(self.outb)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MMonCommandReply":
+        return cls(rc=d.s32(), outs=d.string(), outb=d.string())
+
+
+@register_message
+@dataclass
+class MOSDBoot(Message):
+    """OSD → mon boot announcement (MOSDBoot): mark me up at addr."""
+
+    TYPE = 11
+    osd: int = -1
+    addr: str = ""
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.osd).string(self.addr)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MOSDBoot":
+        return cls(osd=d.s32(), addr=d.string())

@@ -1,0 +1,310 @@
+"""AsyncMessenger — asyncio connection fabric behind the Messenger
+contract (src/msg/Messenger.h:89,393-425; src/msg/async/AsyncMessenger.h).
+
+One Messenger owns one asyncio event loop on a daemon thread (the
+EventCenter role).  ``bind()`` starts a TCP listener; ``connect()``
+dials out.  Both directions speak the same framed protocol
+(message.py): a fixed banner exchange, then crc-framed typed messages.
+
+Dispatch mirrors the reference: inbound messages walk the dispatcher
+chain until one claims the type (ms_dispatch); connection teardown
+notifies ms_handle_reset.  RPC-style request/reply (the sub-op
+pattern) is provided by ``Connection.call`` — the reply is paired by
+tid, exactly how ECBackend matches sub-op replies to in-flight ops.
+
+The API is synchronous on purpose: callers (stores, daemons, tests)
+are plain Python; every sync call marshals onto the loop thread via
+``run_coroutine_threadsafe``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import threading
+import time
+
+from .message import Message, MessageError
+
+BANNER = b"ceph-tpu-msgr/2\n"
+_CALL_TIMEOUT = 30.0
+
+
+class Dispatcher:
+    """The Dispatcher contract (Messenger.h:89): return True from
+    ms_dispatch to claim a message."""
+
+    def ms_dispatch(self, conn: "Connection", msg: Message) -> bool:
+        raise NotImplementedError
+
+    def ms_handle_reset(self, conn: "Connection") -> None:
+        pass
+
+
+class Connection:
+    """One framed peer link (AsyncConnection role)."""
+
+    def __init__(self, msgr: "Messenger", reader, writer, outgoing: bool):
+        self.msgr = msgr
+        self._reader = reader
+        self._writer = writer
+        self.outgoing = outgoing
+        self.peer_addr = writer.get_extra_info("peername")
+        # pending replies are concurrent futures: resolved from the
+        # loop thread, awaited from caller threads (thread-safe both
+        # ways, unlike asyncio futures)
+        self._pending: dict[int, concurrent.futures.Future] = {}
+        self._plock = threading.Lock()
+        self._closed = False
+        self._send_lock = asyncio.Lock()
+
+    # -- sync API ----------------------------------------------------------
+    def send(self, msg: Message) -> None:
+        """Fire-and-forget (Messenger::send_to)."""
+        self.msgr._run(self._send(msg))
+
+    def call(
+        self, msg: Message, timeout: float = _CALL_TIMEOUT
+    ) -> Message:
+        """Send and wait for the tid-paired reply (sub-op pattern).
+        Raises MessageError on connection loss or timeout."""
+        if msg.tid == 0:
+            msg.tid = self.msgr.new_tid()
+        cf: concurrent.futures.Future = concurrent.futures.Future()
+        with self._plock:
+            if self._closed:
+                raise MessageError("connection closed")
+            self._pending[msg.tid] = cf
+        try:
+            self.msgr._run(self._send(msg)).result(timeout)
+            return cf.result(timeout)
+        except MessageError:
+            raise
+        except concurrent.futures.TimeoutError as e:
+            raise MessageError(f"call tid={msg.tid} timed out") from e
+        except Exception as e:
+            raise MessageError(
+                f"call tid={msg.tid} failed: {type(e).__name__}: {e}"
+            ) from e
+        finally:
+            with self._plock:
+                self._pending.pop(msg.tid, None)
+
+    def close(self) -> None:
+        if self.msgr._loop is not None and not self._closed:
+            self.msgr._run(self._close())
+
+    @property
+    def is_closed(self) -> bool:
+        return self._closed
+
+    # -- loop-side ---------------------------------------------------------
+    async def _send(self, msg: Message) -> None:
+        if self._closed:
+            raise MessageError("connection closed")
+        frame = msg.to_frame()
+        async with self._send_lock:
+            self._writer.write(frame)
+            await self._writer.drain()
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                header = await self._reader.readexactly(
+                    Message.HEADER_SIZE
+                )
+                mtype, tid, plen = Message.parse_header(header)
+                body = await self._reader.readexactly(plen + 4)
+                msg = Message.from_payload(
+                    mtype,
+                    tid,
+                    body[:plen],
+                    int.from_bytes(body[plen:], "little"),
+                )
+                with self._plock:
+                    fut = self._pending.pop(tid, None)
+                if fut is not None:
+                    if not fut.set_running_or_notify_cancel():
+                        continue  # caller gave up (timeout)
+                    fut.set_result(msg)
+                else:
+                    self.msgr._dispatch(self, msg)
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            MessageError,
+            OSError,
+        ):
+            pass
+        finally:
+            await self._close()
+
+    async def _close(self) -> None:
+        if self._closed:
+            return
+        with self._plock:
+            self._closed = True
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for fut in pending:
+            if fut.set_running_or_notify_cancel():
+                fut.set_exception(MessageError("connection reset"))
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+        self.msgr._conn_reset(self)
+
+
+class Messenger:
+    """Messenger::create + bind/start/shutdown lifecycle."""
+
+    def __init__(self, name: str = "client"):
+        self.name = name
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._dispatchers: list[Dispatcher] = []
+        self._conns: set[Connection] = set()
+        self._tid = 0
+        self._tid_lock = threading.Lock()
+        self.bound_addr: tuple[str, int] | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._loop is not None:
+            return
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever,
+            name=f"msgr-{self.name}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def bind(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        """Listen; returns the bound (host, port)."""
+        self.start()
+
+        async def _serve():
+            self._server = await asyncio.start_server(
+                self._accept, host, port
+            )
+            return self._server.sockets[0].getsockname()[:2]
+
+        self.bound_addr = self._run(_serve()).result(10)
+        return self.bound_addr
+
+    def connect(
+        self, host: str, port: int, timeout: float = 10.0
+    ) -> Connection:
+        self.start()
+
+        async def _dial():
+            reader, writer = await asyncio.open_connection(host, port)
+            writer.write(BANNER)
+            await writer.drain()
+            peer = await reader.readexactly(len(BANNER))
+            if peer != BANNER:
+                writer.close()
+                raise MessageError("banner mismatch")
+            conn = Connection(self, reader, writer, outgoing=True)
+            self._conns.add(conn)
+            self._loop.create_task(conn._read_loop())
+            return conn
+
+        try:
+            return self._run(_dial()).result(timeout)
+        except MessageError:
+            raise
+        except Exception as e:
+            raise MessageError(
+                f"connect {host}:{port} failed: {e}"
+            ) from e
+
+    def shutdown(self) -> None:
+        if self._loop is None:
+            return
+
+        async def _stop():
+            if self._server is not None:
+                self._server.close()
+            for conn in list(self._conns):
+                await conn._close()
+
+        self._run(_stop()).result(10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+        self._loop.close()
+        self._loop = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -- dispatch ----------------------------------------------------------
+    def add_dispatcher(self, d: Dispatcher) -> None:
+        """add_dispatcher_head: earlier dispatchers see messages first."""
+        self._dispatchers.append(d)
+
+    def _dispatch(self, conn: Connection, msg: Message) -> None:
+        for d in self._dispatchers:
+            try:
+                if d.ms_dispatch(conn, msg):
+                    return
+            except Exception:  # noqa: BLE001 — a dispatcher must not
+                # kill the read loop; the reference logs and drops too
+                import traceback
+
+                traceback.print_exc()
+                return
+
+    def _conn_reset(self, conn: Connection) -> None:
+        self._conns.discard(conn)
+        for d in self._dispatchers:
+            try:
+                d.ms_handle_reset(conn)
+            except Exception:
+                pass
+
+    # -- internals ---------------------------------------------------------
+    def new_tid(self) -> int:
+        with self._tid_lock:
+            self._tid += 1
+            return self._tid
+
+    def _run(self, coro):
+        if self._loop is None:
+            raise MessageError("messenger not started")
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    async def _accept(self, reader, writer) -> None:
+        try:
+            writer.write(BANNER)
+            await writer.drain()
+            peer = await asyncio.wait_for(
+                reader.readexactly(len(BANNER)), 10
+            )
+            if peer != BANNER:
+                writer.close()
+                return
+        except Exception:
+            writer.close()
+            return
+        conn = Connection(self, reader, writer, outgoing=False)
+        self._conns.add(conn)
+        await conn._read_loop()
+
+
+def wait_for(predicate, timeout: float, interval: float = 0.02) -> bool:
+    """Poll helper for tests/daemons."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()

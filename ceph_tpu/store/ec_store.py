@@ -177,11 +177,15 @@ class ECStore:
         falls back to a crc-verified full decode.  Returns helper
         bytes read."""
         meta = self._shard_meta(name)
-        available = {
-            i
-            for i in range(self.n)
-            if i != shard and self.stores[i].exists(self.cid, name)
-        }
+        available = set()
+        for i in range(self.n):
+            if i == shard:
+                continue
+            try:
+                if self.stores[i].exists(self.cid, name):
+                    available.add(i)
+            except StoreError:
+                pass  # unreachable shard: not a helper candidate
         read_bytes = 0
         rebuilt = None
         try:

@@ -1,0 +1,134 @@
+"""Messenger layer tests: frame codec, dispatch, RPC pairing, resets
+(SURVEY.md §2.4 Messenger row; src/msg/Messenger.h:89 contract)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.msg import (
+    MECSubRead,
+    MECSubWrite,
+    MECSubWriteReply,
+    MPing,
+    Message,
+    MessageError,
+    Messenger,
+)
+from ceph_tpu.msg.message import (
+    READ_DATA,
+    decode_transaction,
+    encode_transaction,
+)
+from ceph_tpu.common.encoding import Decoder, Encoder
+from ceph_tpu.msg.messenger import Dispatcher, wait_for
+from ceph_tpu.store.objectstore import Transaction
+
+
+def test_frame_roundtrip():
+    msg = MPing(tid=7, from_osd=3, stamp=1.5)
+    frame = msg.to_frame()
+    mtype, tid, plen = Message.parse_header(frame[: Message.HEADER_SIZE])
+    assert (mtype, tid) == (MPing.TYPE, 7)
+    payload = frame[Message.HEADER_SIZE : Message.HEADER_SIZE + plen]
+    crc = int.from_bytes(frame[Message.HEADER_SIZE + plen :], "little")
+    out = Message.from_payload(mtype, tid, payload, crc)
+    assert isinstance(out, MPing)
+    assert out.from_osd == 3 and out.stamp == 1.5
+
+
+def test_frame_corruption_detected():
+    frame = bytearray(MPing(tid=1, from_osd=2).to_frame())
+    frame[5] ^= 0xFF
+    with pytest.raises(MessageError):
+        Message.parse_header(bytes(frame[: Message.HEADER_SIZE]))
+
+
+def test_transaction_codec_roundtrip():
+    txn = (
+        Transaction()
+        .create_collection("coll")
+        .touch("coll", "obj")
+        .write("coll", "obj", 16, b"hello")
+        .truncate("coll", "obj", 8)
+        .setattr("coll", "obj", "k", b"v")
+        .rmattr("coll", "obj", "k")
+        .remove("coll", "obj")
+        .remove_collection("coll")
+    )
+    e = Encoder()
+    encode_transaction(e, txn)
+    out = decode_transaction(Decoder(e.getvalue()))
+    assert out.ops == txn.ops
+
+
+class _Echo(Dispatcher):
+    def __init__(self):
+        self.resets = 0
+
+    def ms_dispatch(self, conn, msg):
+        if isinstance(msg, MPing) and not msg.is_reply:
+            conn.send(
+                MPing(
+                    tid=msg.tid, from_osd=99, stamp=msg.stamp,
+                    is_reply=True,
+                )
+            )
+            return True
+        return False
+
+    def ms_handle_reset(self, conn):
+        self.resets += 1
+
+
+def test_call_reply_pairing_and_reset():
+    server = Messenger("server")
+    echo = _Echo()
+    server.add_dispatcher(echo)
+    host, port = server.bind()
+    client = Messenger("client")
+    try:
+        conn = client.connect(host, port)
+        # concurrent calls pair replies by tid
+        results = {}
+
+        def call(i):
+            results[i] = conn.call(MPing(from_osd=i, stamp=float(i)))
+
+        threads = [
+            threading.Thread(target=call, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(8):
+            assert results[i].stamp == float(i)
+            assert results[i].is_reply
+        # server going away resets the client connection
+        server.shutdown()
+        assert wait_for(lambda: conn.is_closed, 5)
+        with pytest.raises(MessageError):
+            conn.call(MPing(from_osd=1), timeout=2)
+    finally:
+        client.shutdown()
+        if server._loop is not None:
+            server.shutdown()
+
+
+def test_unclaimed_message_drops_silently():
+    server = Messenger("server")
+    server.add_dispatcher(_Echo())
+    host, port = server.bind()
+    client = Messenger("client")
+    try:
+        conn = client.connect(host, port)
+        # MECSubWrite is not claimed by _Echo; connection must survive
+        conn.send(MECSubWrite(tid=client.new_tid(), txn=Transaction()))
+        time.sleep(0.1)
+        assert conn.call(MPing(from_osd=1)).is_reply
+    finally:
+        client.shutdown()
+        server.shutdown()

@@ -1,0 +1,204 @@
+"""Distributed EC data plane: ECStore with every shard behind a real
+network boundary — in-process servers for the fast tier, separate OS
+processes for the integration tier (the qa/standalone analog:
+multi-daemon single host, SURVEY.md §4.2).
+
+Covers VERDICT round-1 item 2: EC write/read/recovery through
+messenger sub-ops, and shard-process death detected by heartbeats
+(osd/failure.py) feeding the failure-report path.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ceph_tpu.msg import MessageError, Messenger
+from ceph_tpu.msg.messenger import wait_for
+from ceph_tpu.osd.failure import FailureAggregator, HeartbeatTracker
+from ceph_tpu.store.ec_store import ECStore
+from ceph_tpu.store.objectstore import MemStore, StoreError, Transaction
+from ceph_tpu.store.remote import RemoteStore, ShardServer
+
+PROFILE = {"technique": "reed_sol_van", "k": "3", "m": "2", "w": "8"}
+N = 5
+
+
+# -- tier 1: in-process servers (fast) -------------------------------------
+
+
+@pytest.fixture
+def local_cluster():
+    """N shard servers, each on its own messenger/port, one client."""
+    servers = []
+    client = Messenger("client")
+    stores = []
+    try:
+        for i in range(N):
+            m = Messenger(f"osd.{i}")
+            m.add_dispatcher(ShardServer(whoami=i))
+            host, port = m.bind()
+            servers.append(m)
+            stores.append(RemoteStore(client.connect(host, port)))
+        yield ECStore(
+            plugin="jerasure", profile=dict(PROFILE), stores=stores
+        )
+    finally:
+        client.shutdown()
+        for m in servers:
+            if m._loop is not None:
+                m.shutdown()
+
+
+def test_remote_store_basic_ops():
+    server = Messenger("osd.0")
+    backing = MemStore()
+    server.add_dispatcher(ShardServer(store=backing, whoami=0))
+    host, port = server.bind()
+    client = Messenger("client")
+    try:
+        rs = RemoteStore(client.connect(host, port))
+        rs.queue_transaction(
+            Transaction()
+            .create_collection("c")
+            .touch("c", "o")
+            .write("c", "o", 0, b"abcdefgh")
+            .setattr("c", "o", "k", b"v")
+        )
+        assert rs.read("c", "o") == b"abcdefgh"
+        assert rs.read("c", "o", 2, 3) == b"cde"
+        assert rs.getattr("c", "o", "k") == b"v"
+        assert rs.stat("c", "o") == 8
+        assert rs.exists("c", "o")
+        assert not rs.exists("c", "nope")
+        assert rs.list_objects("c") == ["o"]
+        with pytest.raises(StoreError):
+            rs.read("c", "nope")
+        # the proxy writes land in the server's backing store
+        assert backing.read("c", "o") == b"abcdefgh"
+        assert rs.ping(from_osd=-1) < 5
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_ec_write_read_over_network(local_cluster):
+    ec = local_cluster
+    payload = bytes(range(256)) * 41  # not stripe aligned
+    ec.put("obj", payload)
+    assert ec.get("obj") == payload
+
+
+def test_ec_degraded_read_and_recovery_over_network(local_cluster):
+    ec = local_cluster
+    payload = b"\xa5" * 10000 + b"tail"
+    ec.put("obj", payload)
+    ec.lose_shard("obj", 1)
+    ec.corrupt_shard("obj", 3)
+    assert ec.get("obj") == payload  # reconstructing read
+    assert ec.recover_shard("obj", 1) > 0
+    assert ec.recover_shard("obj", 3) > 0
+    assert ec.scrub("obj").clean
+
+
+# -- tier 2: real processes + heartbeat failure detection ------------------
+
+
+def _spawn_shard(osd_id: int):
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "ceph_tpu.store.remote",
+            "--osd-id", str(osd_id),
+        ],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    line = proc.stdout.readline().strip()
+    assert line.startswith("shard_daemon ready "), line
+    host, port = line.rsplit(" ", 1)[1].split(":")
+    return proc, host, int(port)
+
+
+@pytest.mark.slow
+def test_ec_over_processes_with_heartbeat_failure_detection():
+    procs = []
+    client = Messenger("client")
+    try:
+        stores = []
+        for i in range(N):
+            proc, host, port = _spawn_shard(i)
+            procs.append(proc)
+            stores.append(RemoteStore(client.connect(host, port)))
+        ec = ECStore(
+            plugin="jerasure", profile=dict(PROFILE), stores=stores
+        )
+        payload = bytes(range(256)) * 100
+        ec.put("obj", payload)
+        assert ec.get("obj") == payload
+
+        # heartbeat plane: the primary (osd -1) tracks all shards
+        tracker = HeartbeatTracker(whoami=-1, grace=1.0)
+        now = time.monotonic()
+        for i in range(N):
+            tracker.add_peer(i, now)
+
+        def ping_round():
+            now = time.monotonic()
+            for i, rs in enumerate(stores):
+                try:
+                    rs.ping(from_osd=-1, timeout=2)
+                    tracker.handle_ping(i, time.monotonic())
+                except MessageError:
+                    pass
+            return now
+
+        ping_round()
+        assert tracker.failures(time.monotonic()) == []
+
+        # kill one shard process: reads survive, heartbeats notice
+        procs[2].kill()
+        procs[2].wait(10)
+        assert ec.get("obj") == payload  # degraded read path
+
+        assert wait_for(
+            lambda: (
+                ping_round(),
+                [f[0] for f in tracker.failures(time.monotonic())]
+                == [2],
+            )[1],
+            timeout=10,
+        )
+        # failure reports tip the aggregator exactly like the monitor
+        from ceph_tpu.crush import CRUSH_BUCKET_STRAW2, CrushMap
+        from ceph_tpu.osd import OSDMap
+
+        cmap = CrushMap()
+        cmap.add_bucket(
+            CRUSH_BUCKET_STRAW2, 1, list(range(N)), [0x10000] * N,
+            name="host0",
+        )
+        om = OSDMap.build(cmap, N)
+        agg = FailureAggregator(om, min_reporters=2)
+        assert not agg.report_failure(2, 0, time.monotonic())
+        assert agg.report_failure(2, 1, time.monotonic())
+        assert om.is_down(2)
+
+        # recovery onto a fresh replacement shard process
+        proc, host, port = _spawn_shard(N)
+        procs.append(proc)
+        stores[2] = RemoteStore(client.connect(host, port))
+        # a fresh OSD creates the PG collection when it joins (peering)
+        stores[2].queue_transaction(
+            Transaction().create_collection(ec.cid)
+        )
+        ec.stores[2] = stores[2]
+        assert ec.recover_shard("obj", 2) > 0
+        assert ec.scrub("obj").clean
+        assert ec.get("obj") == payload
+    finally:
+        client.shutdown()
+        for p in procs:
+            p.kill()
