@@ -1,0 +1,459 @@
+"""Monitor service + client (Monitor.cc / OSDMonitor.cc / MonClient.cc).
+
+``Monitor`` owns the authoritative OSDMap.  Mutations arrive as
+``Incremental``s (from commands, boot messages, or the failure
+aggregator), are committed to the ``MonitorStore`` log, applied, and
+pushed to every subscriber — the PaxosService propose→commit→notify
+cycle with the quorum collapsed to one node (deviation documented in
+the package docstring).
+
+``MonitorStore`` is the MonitorDBStore role: a versioned blob log
+("osdmap_full_<e>" / "osdmap_inc_<e>" keys) behind the ObjectStore
+transaction API, so swapping in the persistent store gives mon-state
+durability for free.
+
+``MonClient`` keeps a daemon's local map current: subscribe from the
+current epoch, apply pushed incrementals, surface epoch changes to a
+callback (the OSD's handle_osd_map role).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ..msg import (
+    MOSDMap,
+    Message,
+    MessageError,
+    Messenger,
+)
+from ..msg.message import (
+    MMonCommand,
+    MMonCommandReply,
+    MMonSubscribe,
+    MOSDBoot,
+    MOSDFailure,
+)
+from ..msg.messenger import Connection, Dispatcher
+from ..osd.failure import FailureAggregator
+from ..osd.osdmap import Incremental, OSDMap, PgPool
+from ..store.objectstore import MemStore, ObjectStore, StoreError, Transaction
+
+MON_COLL = "mon_store"
+
+
+class MonitorStore:
+    """Versioned map-blob log over an ObjectStore (MonitorDBStore role:
+    every commit is one transaction; replay rebuilds the map chain)."""
+
+    def __init__(self, store: ObjectStore | None = None):
+        self.store = store or MemStore()
+        try:
+            self.store.queue_transaction(
+                Transaction().create_collection(MON_COLL)
+            )
+        except StoreError:
+            pass
+
+    def put_commit(
+        self, epoch: int, inc_blob: bytes | None, full_blob: bytes
+    ) -> None:
+        txn = Transaction()
+        if inc_blob is not None:
+            txn.touch(MON_COLL, f"osdmap_inc_{epoch}")
+            txn.write(MON_COLL, f"osdmap_inc_{epoch}", 0, inc_blob)
+        txn.touch(MON_COLL, f"osdmap_full_{epoch}")
+        txn.write(MON_COLL, f"osdmap_full_{epoch}", 0, full_blob)
+        txn.touch(MON_COLL, "meta")
+        txn.setattr(
+            MON_COLL, "meta", "last_committed", str(epoch).encode()
+        )
+        self.store.queue_transaction(txn)
+
+    def last_committed(self) -> int:
+        try:
+            return int(self.store.getattr(MON_COLL, "meta", "last_committed"))
+        except StoreError:
+            return 0
+
+    def get_inc(self, epoch: int) -> bytes | None:
+        try:
+            return self.store.read(MON_COLL, f"osdmap_inc_{epoch}")
+        except StoreError:
+            return None
+
+    def get_full(self, epoch: int) -> bytes | None:
+        try:
+            return self.store.read(MON_COLL, f"osdmap_full_{epoch}")
+        except StoreError:
+            return None
+
+
+class Monitor(Dispatcher):
+    """Single-node map authority (Monitor + OSDMonitor roles)."""
+
+    def __init__(
+        self,
+        osdmap: OSDMap,
+        store: MonitorStore | None = None,
+        min_reporters: int = 2,
+    ):
+        self.store = store or MonitorStore()
+        self._lock = threading.RLock()
+        replay_to = self.store.last_committed()
+        if replay_to > osdmap.epoch:
+            # cold restart: adopt the highest committed map
+            blob = self.store.get_full(replay_to)
+            if blob is not None:
+                osdmap = OSDMap.decode(blob)
+        self.osdmap = osdmap
+        if self.store.last_committed() < osdmap.epoch:
+            self.store.put_commit(osdmap.epoch, None, osdmap.encode())
+        self.failures = FailureAggregator(
+            osdmap,
+            min_reporters=min_reporters,
+            mark_down_fn=self._commit_mark_down,
+        )
+        # subscribers: conn -> last epoch sent
+        self._subs: dict[Connection, int] = {}
+
+    # -- commit cycle ------------------------------------------------------
+    def commit(self, inc: Incremental) -> int:
+        """propose_pending: apply + log + notify; returns new epoch."""
+        with self._lock:
+            blob = inc.encode()
+            self.osdmap.apply_incremental(inc)
+            self.store.put_commit(
+                self.osdmap.epoch, blob, self.osdmap.encode()
+            )
+            self._push_maps()
+            return self.osdmap.epoch
+
+    def pending(self) -> Incremental:
+        return self.osdmap.new_incremental()
+
+    def _commit_mark_down(self, target: int) -> None:
+        with self._lock:
+            if not self.osdmap.is_up(target):
+                return  # raced with a command; XOR must not re-up it
+            inc = self.pending()
+            inc.mark_down(target)
+            self.commit(inc)
+
+    # -- subscriber fan-out ------------------------------------------------
+    def _map_message(self, since: int) -> MOSDMap:
+        """Incremental run (since, current]; full map if a gap or a
+        fresh subscriber (MOSDMap build semantics)."""
+        cur = self.osdmap.epoch
+        if since <= 0 or since >= cur:
+            incs = []
+        else:
+            incs = [self.store.get_inc(e) for e in range(since + 1, cur + 1)]
+        if since and incs and all(b is not None for b in incs):
+            return MOSDMap(incrementals=incs)
+        return MOSDMap(full=self.osdmap.encode())
+
+    def _push_maps(self) -> None:
+        for conn, sent in list(self._subs.items()):
+            if conn.is_closed:
+                del self._subs[conn]
+                continue
+            try:
+                conn.send(self._map_message(sent))
+                self._subs[conn] = self.osdmap.epoch
+            except MessageError:
+                del self._subs[conn]
+
+    # -- dispatch ----------------------------------------------------------
+    def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if isinstance(msg, MMonSubscribe):
+            with self._lock:
+                self._subs[conn] = self.osdmap.epoch
+                reply = self._map_message(msg.start_epoch)
+                reply.tid = msg.tid
+                conn.send(reply)
+            return True
+        if isinstance(msg, MOSDFailure):
+            with self._lock:
+                if msg.failed_for < 0:
+                    self.failures.cancel_report(msg.target, msg.reporter)
+                else:
+                    self.failures.report_failure(
+                        msg.target, msg.reporter, time.time()
+                    )
+            return True
+        if isinstance(msg, MOSDBoot):
+            with self._lock:
+                inc = self.pending()
+                inc.mark_up(msg.osd, addr=msg.addr)
+                inc.mark_in(msg.osd)
+                self.commit(inc)
+            return True
+        if isinstance(msg, MMonCommand):
+            reply = self.handle_command(msg.cmd)
+            reply.tid = msg.tid
+            conn.send(reply)
+            return True
+        return False
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        self._subs.pop(conn, None)
+
+    # -- command surface (MonCommands.h role) ------------------------------
+    def handle_command(self, cmd_json: str) -> MMonCommandReply:
+        try:
+            cmd = json.loads(cmd_json)
+            prefix = cmd.get("prefix", "")
+            handler = _COMMANDS.get(prefix)
+            if handler is None:
+                return MMonCommandReply(
+                    rc=-22, outs=f"unknown command {prefix!r}"
+                )
+            with self._lock:
+                return handler(self, cmd)
+        except Exception as e:  # noqa: BLE001 — the RPC contract: a
+            # command must ALWAYS produce a reply (a raised handler
+            # would otherwise leave the caller blocked to timeout)
+            return MMonCommandReply(rc=-22, outs=f"{type(e).__name__}: {e}")
+
+
+def _cmd_status(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    m = mon.osdmap
+    up = sum(1 for o in range(m.max_osd) if m.is_up(o))
+    inn = sum(
+        1
+        for o in range(m.max_osd)
+        if m.exists(o) and m.osd_weight[o] > 0
+    )
+    return MMonCommandReply(
+        outb=json.dumps(
+            {
+                "epoch": m.epoch,
+                "num_osds": m.max_osd,
+                "num_up_osds": up,
+                "num_in_osds": inn,
+                "num_pools": len(m.pools),
+            }
+        )
+    )
+
+
+def _cmd_osd_down(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    osd = int(cmd["id"])
+    if not mon.osdmap.is_up(osd):
+        # the state entry is an XOR: re-queueing it for a down OSD
+        # would flip it back up (OSDMonitor guards with is_up too)
+        return MMonCommandReply(outs=f"osd.{osd} is already down")
+    inc = mon.pending()
+    inc.mark_down(osd)
+    epoch = mon.commit(inc)
+    return MMonCommandReply(outs=f"marked down osd.{osd}", outb=json.dumps({"epoch": epoch}))
+
+
+def _cmd_osd_out(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    osd = int(cmd["id"])
+    inc = mon.pending()
+    inc.mark_out(osd)
+    epoch = mon.commit(inc)
+    return MMonCommandReply(outs=f"marked out osd.{osd}", outb=json.dumps({"epoch": epoch}))
+
+
+def _cmd_osd_in(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    osd = int(cmd["id"])
+    inc = mon.pending()
+    inc.mark_in(osd)
+    epoch = mon.commit(inc)
+    return MMonCommandReply(outs=f"marked in osd.{osd}", outb=json.dumps({"epoch": epoch}))
+
+
+def _cmd_osd_reweight(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    osd = int(cmd["id"])
+    weight = float(cmd["weight"])
+    inc = mon.pending()
+    inc.new_weight[osd] = int(weight * 0x10000)
+    epoch = mon.commit(inc)
+    return MMonCommandReply(outb=json.dumps({"epoch": epoch}))
+
+
+def _cmd_pool_create(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    name = cmd["pool"]
+    if name in mon.osdmap.pool_names.values():
+        return MMonCommandReply(rc=-17, outs=f"pool {name!r} exists")
+    pool_id = mon.osdmap.pool_max + 1
+    pool = PgPool(
+        pool_id=pool_id,
+        type=int(cmd.get("pool_type", 1)),
+        size=int(cmd.get("size", 3)),
+        pg_num=int(cmd.get("pg_num", 32)),
+        crush_rule=int(cmd.get("crush_rule", 0)),
+        erasure_code_profile=cmd.get("erasure_code_profile", ""),
+    )
+    inc = mon.pending()
+    inc.new_pools[pool_id] = pool
+    inc.new_pool_names[pool_id] = name
+    inc.new_pool_max = pool_id
+    epoch = mon.commit(inc)
+    return MMonCommandReply(
+        outs=f"pool '{name}' created",
+        outb=json.dumps({"pool_id": pool_id, "epoch": epoch}),
+    )
+
+
+def _cmd_pool_delete(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    name = cmd["pool"]
+    ids = [i for i, n in mon.osdmap.pool_names.items() if n == name]
+    if not ids:
+        return MMonCommandReply(rc=-2, outs=f"pool {name!r} not found")
+    inc = mon.pending()
+    inc.old_pools.add(ids[0])
+    epoch = mon.commit(inc)
+    return MMonCommandReply(outb=json.dumps({"epoch": epoch}))
+
+
+def _cmd_ec_profile_set(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    name = cmd["name"]
+    profile = {}
+    for kv in cmd.get("profile", []):
+        k, _, v = kv.partition("=")
+        profile[k] = v
+    inc = mon.pending()
+    inc.new_erasure_code_profiles[name] = profile
+    epoch = mon.commit(inc)
+    return MMonCommandReply(outb=json.dumps({"epoch": epoch}))
+
+
+def _cmd_osd_dump(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    m = mon.osdmap
+    return MMonCommandReply(
+        outb=json.dumps(
+            {
+                "epoch": m.epoch,
+                "max_osd": m.max_osd,
+                "osds": [
+                    {
+                        "osd": o,
+                        "up": int(m.is_up(o)),
+                        "in": int(m.exists(o) and m.osd_weight[o] > 0),
+                        "weight": m.osd_weight[o] / 0x10000,
+                    }
+                    for o in range(m.max_osd)
+                ],
+                "pools": {
+                    str(pid): {
+                        "name": m.pool_names.get(pid, ""),
+                        "size": p.size,
+                        "pg_num": p.pg_num,
+                        "type": p.type,
+                    }
+                    for pid, p in m.pools.items()
+                },
+            }
+        )
+    )
+
+
+_COMMANDS = {
+    "status": _cmd_status,
+    "osd down": _cmd_osd_down,
+    "osd out": _cmd_osd_out,
+    "osd in": _cmd_osd_in,
+    "osd reweight": _cmd_osd_reweight,
+    "osd dump": _cmd_osd_dump,
+    "osd pool create": _cmd_pool_create,
+    "osd pool delete": _cmd_pool_delete,
+    "osd erasure-code-profile set": _cmd_ec_profile_set,
+}
+
+
+class MonClient(Dispatcher):
+    """Daemon-side map follower (MonClient role): subscribe, apply
+    pushed full/incremental maps, notify ``on_map(epoch)``."""
+
+    def __init__(self, messenger: Messenger, on_map=None, whoami: int = -1):
+        self.messenger = messenger
+        self.whoami = whoami
+        self.on_map = on_map
+        self.osdmap: OSDMap | None = None
+        self._conn: Connection | None = None
+        self._lock = threading.Lock()
+        self._epoch_event = threading.Condition(self._lock)
+        messenger.add_dispatcher(self)
+
+    # -- session -----------------------------------------------------------
+    def connect(self, host: str, port: int) -> None:
+        self._conn = self.messenger.connect(host, port)
+        reply = self._conn.call(
+            MMonSubscribe(start_epoch=0, from_osd=self.whoami)
+        )
+        assert isinstance(reply, MOSDMap)
+        self._apply(reply)
+
+    def command(self, cmd: dict) -> MMonCommandReply:
+        reply = self._conn.call(MMonCommand(cmd=json.dumps(cmd)))
+        assert isinstance(reply, MMonCommandReply)
+        return reply
+
+    def report_failure(self, target: int, failed_for: float) -> None:
+        self._conn.send(
+            MOSDFailure(
+                target=target,
+                reporter=self.whoami,
+                failed_for=failed_for,
+                epoch=self.epoch,
+            )
+        )
+
+    def boot(self, osd: int, addr: str = "") -> None:
+        self._conn.send(MOSDBoot(osd=osd, addr=addr))
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self.osdmap.epoch if self.osdmap else 0
+
+    def wait_for_epoch(self, epoch: int, timeout: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._epoch_event:
+            while self.osdmap is None or self.osdmap.epoch < epoch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._epoch_event.wait(remaining)
+            return True
+
+    # -- map application ---------------------------------------------------
+    def _apply(self, msg: MOSDMap) -> None:
+        resubscribe = False
+        with self._epoch_event:
+            if msg.full:
+                self.osdmap = OSDMap.decode(msg.full)
+            for blob in msg.incrementals:
+                inc = Incremental.decode(blob)
+                if self.osdmap is None or inc.epoch > self.osdmap.epoch + 1:
+                    resubscribe = True  # gap: need a fresh full map
+                    break
+                if inc.epoch <= self.osdmap.epoch:
+                    continue  # dup push (already ahead)
+                self.osdmap.apply_incremental(inc)
+            self._epoch_event.notify_all()
+        if resubscribe and self._conn is not None:
+            # fire-and-forget: the reply dispatches as another MOSDMap
+            # (we are on the read-loop thread here; call() would block it)
+            self._conn.send(
+                MMonSubscribe(
+                    tid=self.messenger.new_tid(),
+                    start_epoch=0,
+                    from_osd=self.whoami,
+                )
+            )
+            return
+        if self.on_map is not None and self.osdmap is not None:
+            self.on_map(self.osdmap.epoch)
+
+    def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if isinstance(msg, MOSDMap):
+            self._apply(msg)
+            return True
+        return False

@@ -66,9 +66,16 @@ class FailureAggregator:
         self,
         osdmap: OSDMap,
         min_reporters: int = MON_OSD_MIN_DOWN_REPORTERS,
+        mark_down_fn=None,
     ):
+        """``mark_down_fn(target)`` commits the down marking; the
+        default mutates the map in place with a bare epoch bump (test
+        convenience).  The monitor passes its own committer so the
+        marking becomes a real Incremental pushed to subscribers
+        (mon/monitor.py)."""
         self.osdmap = osdmap
         self.min_reporters = min_reporters
+        self.mark_down_fn = mark_down_fn
         self._pending: dict[int, _Pending] = {}
 
     def report_failure(
@@ -110,8 +117,13 @@ class FailureAggregator:
                 del self._pending[target]
 
     def _mark_down(self, target: int) -> None:
-        self.osdmap.mark_down(target)
-        self.osdmap.epoch += 1
+        if self.mark_down_fn is not None:
+            self.mark_down_fn(target)
+        else:
+            # stand-alone mode: mutate in place (a real deployment
+            # routes through the monitor's incremental commit)
+            self.osdmap.mark_down(target)
+            self.osdmap.epoch += 1
         self._pending.pop(target, None)
         dout("osd", 0, f"osd.{target} marked down, epoch -> {self.osdmap.epoch}")
 

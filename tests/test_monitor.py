@@ -1,0 +1,223 @@
+"""Monitor (map authority) tests: commit log, subscription push,
+failure-report gating producing REAL incrementals, the JSON command
+surface, and cold-restart replay from the MonitorStore
+(src/mon/Monitor.cc / OSDMonitor.cc / MonClient.cc roles)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.crush import CRUSH_BUCKET_STRAW2, CrushMap
+from ceph_tpu.mon import MonClient, Monitor, MonitorStore
+from ceph_tpu.msg import Messenger
+from ceph_tpu.msg.messenger import wait_for
+from ceph_tpu.osd import OSDMap, PgPool
+
+N = 6
+
+
+def _base_map():
+    cmap = CrushMap()
+    hosts = []
+    for h in range(3):
+        items = [h * 2, h * 2 + 1]
+        hosts.append(
+            cmap.add_bucket(
+                CRUSH_BUCKET_STRAW2, 1, items, [0x10000] * 2,
+                name=f"host{h}",
+            )
+        )
+    cmap.add_bucket(
+        CRUSH_BUCKET_STRAW2, 3, hosts,
+        [cmap.buckets[b].weight for b in hosts], name="default",
+    )
+    cmap.add_simple_rule("rep", "default", "host", mode="firstn")
+    om = OSDMap.build(cmap, N)
+    om.add_pool(PgPool(pool_id=1, size=3, pg_num=16, crush_rule=0))
+    return om
+
+
+@pytest.fixture
+def cluster():
+    mon = Monitor(_base_map())
+    mon_msgr = Messenger("mon")
+    mon_msgr.add_dispatcher(mon)
+    host, port = mon_msgr.bind()
+    clients = []
+    client_msgrs = []
+    try:
+        for i in range(3):
+            m = Messenger(f"client{i}")
+            mc = MonClient(m, whoami=i)
+            mc.connect(host, port)
+            clients.append(mc)
+            client_msgrs.append(m)
+        yield mon, clients, (host, port)
+    finally:
+        for m in client_msgrs:
+            m.shutdown()
+        mon_msgr.shutdown()
+
+
+def test_subscribe_gets_full_map(cluster):
+    mon, clients, _ = cluster
+    for mc in clients:
+        assert mc.osdmap is not None
+        assert mc.osdmap.epoch == mon.osdmap.epoch
+        assert mc.osdmap.max_osd == N
+
+
+def test_commit_pushes_incrementals(cluster):
+    mon, clients, _ = cluster
+    start = mon.osdmap.epoch
+    inc = mon.pending()
+    inc.mark_down(4)
+    mon.commit(inc)
+    inc = mon.pending()
+    inc.new_weight[1] = 0x8000
+    mon.commit(inc)
+    for mc in clients:
+        assert mc.wait_for_epoch(start + 2)
+        assert not mc.osdmap.is_up(4)
+        assert mc.osdmap.osd_weight[1] == 0x8000
+
+
+def test_failure_reports_commit_incremental(cluster):
+    mon, clients, _ = cluster
+    start = mon.osdmap.epoch
+    clients[0].report_failure(5, failed_for=25.0)
+    time.sleep(0.2)
+    assert mon.osdmap.is_up(5)  # one reporter is not enough
+    clients[1].report_failure(5, failed_for=30.0)
+    assert wait_for(lambda: not mon.osdmap.is_up(5), 5)
+    # the marking is a real incremental in the log, not a bare bump
+    assert mon.store.get_inc(start + 1) is not None
+    for mc in clients:
+        assert mc.wait_for_epoch(start + 1)
+        assert not mc.osdmap.is_up(5)
+
+
+def test_boot_marks_up(cluster):
+    mon, clients, _ = cluster
+    inc = mon.pending()
+    inc.mark_down(2)
+    inc.mark_out(2)
+    mon.commit(inc)
+    start = mon.osdmap.epoch
+    clients[0].boot(2, addr="127.0.0.1:6802")
+    assert wait_for(lambda: mon.osdmap.is_up(2), 5)
+    assert mon.osdmap.osd_weight[2] == 0x10000
+    assert mon.osdmap.osd_addrs[2] == "127.0.0.1:6802"
+    for mc in clients:
+        assert mc.wait_for_epoch(start + 1)
+
+
+def test_command_surface(cluster):
+    mon, clients, _ = cluster
+    mc = clients[0]
+    import json
+
+    r = mc.command({"prefix": "status"})
+    assert r.rc == 0
+    assert json.loads(r.outb)["num_osds"] == N
+
+    r = mc.command(
+        {"prefix": "osd pool create", "pool": "mypool", "pg_num": 8,
+         "size": 2}
+    )
+    assert r.rc == 0
+    pool_id = json.loads(r.outb)["pool_id"]
+    assert mc.wait_for_epoch(json.loads(r.outb)["epoch"])
+    assert mc.osdmap.pools[pool_id].pg_num == 8
+    up, upp, _, _ = mc.osdmap.pg_to_up_acting_osds(pool_id, 0)
+    assert len(up) == 2
+
+    r = mc.command({"prefix": "osd pool create", "pool": "mypool"})
+    assert r.rc == -17  # EEXIST
+
+    r = mc.command(
+        {"prefix": "osd erasure-code-profile set", "name": "p1",
+         "profile": ["k=4", "m=2", "plugin=jerasure"]}
+    )
+    assert r.rc == 0
+    r = mc.command({"prefix": "osd out", "id": 3})
+    assert r.rc == 0
+    r = mc.command({"prefix": "osd dump"})
+    dump = json.loads(r.outb)
+    assert dump["osds"][3]["in"] == 0
+    assert dump["pools"][str(pool_id)]["name"] == "mypool"
+
+    r = mc.command({"prefix": "nonsense"})
+    assert r.rc == -22
+
+    r = mc.command({"prefix": "osd pool delete", "pool": "mypool"})
+    assert r.rc == 0
+    assert wait_for(lambda: pool_id not in clients[1].osdmap.pools, 5)
+
+
+def test_monitor_cold_restart_replays_log():
+    store = MonitorStore()
+    mon = Monitor(_base_map(), store=store)
+    inc = mon.pending()
+    inc.mark_down(0)
+    mon.commit(inc)
+    inc = mon.pending()
+    inc.new_weight[3] = 0x4000
+    final_epoch = mon.commit(inc)
+
+    # new monitor process over the same store: adopts the committed map
+    mon2 = Monitor(_base_map(), store=store)
+    assert mon2.osdmap.epoch == final_epoch
+    assert not mon2.osdmap.is_up(0)
+    assert mon2.osdmap.osd_weight[3] == 0x4000
+
+
+def test_late_subscriber_catches_up(cluster):
+    mon, clients, addr = cluster
+    for w in (0x9000, 0xA000, 0xB000):
+        inc = mon.pending()
+        inc.new_weight[0] = w
+        mon.commit(inc)
+    m = Messenger("late")
+    try:
+        mc = MonClient(m, whoami=9)
+        mc.connect(*addr)
+        assert mc.osdmap.epoch == mon.osdmap.epoch
+        assert mc.osdmap.osd_weight[0] == 0xB000
+        # and keeps following subsequent commits incrementally
+        inc = mon.pending()
+        inc.mark_down(1)
+        mon.commit(inc)
+        assert mc.wait_for_epoch(mon.osdmap.epoch)
+        assert not mc.osdmap.is_up(1)
+    finally:
+        m.shutdown()
+
+
+def test_osd_down_twice_does_not_resurrect(cluster):
+    """The state entry is an XOR (OSDMap.cc:2177): a second mark-down
+    must be refused, not flip the OSD back up."""
+    mon, clients, _ = cluster
+    r = clients[0].command({"prefix": "osd down", "id": 2})
+    assert r.rc == 0
+    assert not mon.osdmap.is_up(2)
+    r = clients[0].command({"prefix": "osd down", "id": 2})
+    assert r.rc == 0 and "already down" in r.outs
+    assert not mon.osdmap.is_up(2)
+
+
+def test_bad_command_returns_error_not_timeout(cluster):
+    """A handler exception must still produce a reply (the RPC
+    contract) and must not half-apply a map at a phantom epoch."""
+    mon, clients, _ = cluster
+    epoch = mon.osdmap.epoch
+    t0 = time.monotonic()
+    r = clients[0].command(
+        {"prefix": "osd reweight", "id": 999, "weight": 0.5}
+    )
+    assert r.rc == -22
+    assert time.monotonic() - t0 < 5  # no 30s hang
+    assert mon.osdmap.epoch == epoch  # nothing applied
+    assert mon.store.last_committed() == epoch
