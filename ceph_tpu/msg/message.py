@@ -20,7 +20,11 @@ from dataclasses import dataclass, field
 
 from ..common.encoding import Decoder, Encoder
 from ..native import ceph_crc32c
-from ..store.objectstore import Transaction
+from ..store.objectstore import (
+    Transaction,
+    decode_transaction,
+    encode_transaction,
+)
 
 FRAME_MAGIC = 0x43545546  # "CTUF"
 _HEADER = struct.Struct("<IHHQI")
@@ -102,60 +106,6 @@ class Message:
         return msg
 
     HEADER_SIZE = _HEADER.size + 4
-
-
-# -- transaction / op serialization ----------------------------------------
-
-_TXN_OPS = {
-    "mkcoll": "cs",
-    "touch": "css",
-    "write": "cssqb",
-    "truncate": "cssq",
-    "setattr": "csssb",
-    "rmattr": "csss",
-    "remove": "css",
-    "rmcoll": "cs",
-}
-# field codes: c=opcode string, s=str, q=int, b=bytes
-_OPCODES = {name: i for i, name in enumerate(sorted(_TXN_OPS))}
-_OPNAMES = {i: name for name, i in _OPCODES.items()}
-
-
-def encode_transaction(e: Encoder, txn: Transaction) -> None:
-    """Serialize the ordered op list (Transaction.h op encoding role)."""
-    e.u32(len(txn.ops))
-    for op in txn.ops:
-        name = op[0]
-        spec = _TXN_OPS[name]
-        e.u8(_OPCODES[name])
-        for kind, val in zip(spec[1:], op[1:]):
-            if kind == "s":
-                e.string(val if val is not None else "")
-            elif kind == "q":
-                e.s64(val)
-            elif kind == "b":
-                e.bytes(val)
-
-
-def decode_transaction(d: Decoder) -> Transaction:
-    txn = Transaction()
-    for _ in range(d.u32()):
-        name = _OPNAMES[d.u8()]
-        spec = _TXN_OPS[name]
-        args = []
-        for kind in spec[1:]:
-            if kind == "s":
-                args.append(d.string())
-            elif kind == "q":
-                args.append(d.s64())
-            elif kind == "b":
-                args.append(d.bytes())
-        if name in ("mkcoll", "rmcoll"):
-            args = args[:1]  # stored as (op, cid, None)
-            txn.ops.append((name, args[0], None))
-        else:
-            txn.ops.append((name, *args))
-    return txn
 
 
 # -- concrete messages -----------------------------------------------------

@@ -1,0 +1,192 @@
+"""KStore — persistent ObjectStore: WAL + checkpoint over files.
+
+The reference's default store is BlueStore (raw block device, RocksDB
+metadata, its own WAL — src/os/bluestore/BlueStore.cc, 16k LoC); its
+simpler sibling KStore keeps everything in the KV log.  This store
+takes the KStore-class design, re-rendered for the framework:
+
+- **write-ahead log**: every transaction is framed (length + crc32c
+  over the framework transaction encoding, msg/message.py) and
+  fsync'd to ``wal.log`` BEFORE the in-memory apply — commit means
+  "in the WAL", exactly the ObjectStore::queue_transaction durability
+  contract (src/os/ObjectStore.h:215).
+- **checkpoint**: ``compact()`` snapshots the full state to
+  ``snap.bin`` (write-to-temp + fsync + atomic rename) and truncates
+  the WAL; crash anywhere leaves either the old or the new snapshot.
+- **mount replay**: load the snapshot, then re-apply WAL entries in
+  order; a torn tail (partial frame, crc mismatch — the
+  kill-mid-write case) is detected and discarded, matching journal
+  replay semantics.
+
+Deviation from BlueStore, documented: no raw-block allocator, no
+compression/checksum-per-blob, no RocksDB — object data lives in the
+snapshot + WAL stream.  The Transaction API, atomicity, and
+crash-restart behavior are the parity surface (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import threading
+
+from ..common.encoding import Decoder, DecodeError, Encoder
+from ..native import ceph_crc32c
+from .objectstore import (
+    MemStore,
+    StoreError,
+    Transaction,
+    decode_transaction,
+    encode_transaction,
+)
+
+_SNAP = "snap.bin"
+_WAL = "wal.log"
+_SNAP_MAGIC = 0x4B53544F  # "KSTO"
+
+
+class KStore(MemStore):
+    """File-backed store; state in RAM, durability via WAL+snapshot."""
+
+    def __init__(self, path: str | os.PathLike, sync: bool = True):
+        super().__init__()
+        self.path = pathlib.Path(path)
+        self.sync = sync
+        self.path.mkdir(parents=True, exist_ok=True)
+        self._wal_lock = threading.Lock()
+        self._mount()
+        self._wal = open(self.path / _WAL, "ab")
+
+    # -- durability plumbing ----------------------------------------------
+    def queue_transaction(self, txn: Transaction) -> None:
+        # validate + apply under the memstore lock, but WAL-append
+        # first: an entry is only written once the ops are known to
+        # apply cleanly, so we shadow-apply, then log, then commit.
+        with self._lock:
+            from .objectstore import _TxnState
+
+            st = _TxnState(self)
+            for op in txn.ops:
+                self._apply(st, op)
+            with self._wal_lock:
+                self._wal.write(self._frame(txn))
+                self._wal.flush()
+                if self.sync:
+                    os.fsync(self._wal.fileno())
+            self._commit(st)
+
+    @staticmethod
+    def _frame(txn: Transaction) -> bytes:
+        e = Encoder()
+        encode_transaction(e, txn)
+        body = e.getvalue()
+        return (
+            len(body).to_bytes(4, "little")
+            + ceph_crc32c(0, body).to_bytes(4, "little")
+            + body
+        )
+
+    def compact(self) -> None:
+        """Checkpoint: snapshot full state, truncate the WAL."""
+        with self._lock:
+            blob = self._snapshot()
+            tmp = self.path / (_SNAP + ".tmp")
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            tmp.replace(self.path / _SNAP)
+            with self._wal_lock:
+                self._wal.close()
+                self._wal = open(self.path / _WAL, "wb")
+                if self.sync:
+                    os.fsync(self._wal.fileno())
+
+    def close(self) -> None:
+        with self._wal_lock:
+            if not self._wal.closed:
+                self._wal.flush()
+                if self.sync:
+                    os.fsync(self._wal.fileno())
+                self._wal.close()
+
+    # -- snapshot format ---------------------------------------------------
+    def _snapshot(self) -> bytes:
+        e = Encoder()
+        e.u32(_SNAP_MAGIC)
+        e.u32(len(self._colls))
+        for cid in sorted(self._colls):
+            e.string(cid)
+            objs = self._colls[cid]
+            e.u32(len(objs))
+            for oid in sorted(objs):
+                obj = objs[oid]
+                e.string(oid)
+                e.bytes(bytes(obj.data))
+                e.map(
+                    obj.xattrs,
+                    lambda e2, k: e2.string(k),
+                    lambda e2, v: e2.bytes(v),
+                )
+        body = e.getvalue()
+        return body + ceph_crc32c(0, body).to_bytes(4, "little")
+
+    def _load_snapshot(self, blob: bytes) -> None:
+        from .objectstore import _Object
+
+        if len(blob) < 4:
+            raise DecodeError("snapshot too short")
+        body, crc = blob[:-4], int.from_bytes(blob[-4:], "little")
+        if ceph_crc32c(0, body) != crc:
+            raise DecodeError("snapshot crc mismatch")
+        d = Decoder(body)
+        if d.u32() != _SNAP_MAGIC:
+            raise DecodeError("bad snapshot magic")
+        for _ in range(d.u32()):
+            cid = d.string()
+            coll: dict = {}
+            for _ in range(d.u32()):
+                oid = d.string()
+                obj = _Object()
+                obj.data = bytearray(d.bytes())
+                obj.xattrs = d.map(
+                    lambda d2: d2.string(), lambda d2: d2.bytes()
+                )
+                coll[oid] = obj
+            self._colls[cid] = coll
+
+    # -- mount / replay ----------------------------------------------------
+    def _mount(self) -> None:
+        snap = self.path / _SNAP
+        if snap.exists():
+            self._load_snapshot(snap.read_bytes())
+        wal = self.path / _WAL
+        if not wal.exists():
+            return
+        raw = wal.read_bytes()
+        pos = 0
+        replayed = 0
+        while pos + 8 <= len(raw):
+            blen = int.from_bytes(raw[pos : pos + 4], "little")
+            crc = int.from_bytes(raw[pos + 4 : pos + 8], "little")
+            body = raw[pos + 8 : pos + 8 + blen]
+            if len(body) < blen or ceph_crc32c(0, body) != crc:
+                break  # torn tail: a transaction died mid-write
+            try:
+                txn = decode_transaction(Decoder(body))
+            except DecodeError:
+                break
+            try:
+                super().queue_transaction(txn)
+            except StoreError:
+                # an entry that no longer applies cleanly (snapshot
+                # already contains it and the op is not idempotent,
+                # e.g. mkcoll): possible only for WAL entries logged
+                # before the last compact raced a crash; skip it
+                pass
+            pos += 8 + blen
+            replayed += 1
+        if pos < len(raw):
+            # drop the torn tail so future appends start clean
+            with open(wal, "r+b") as f:
+                f.truncate(pos)

@@ -14,6 +14,8 @@ import copy
 import threading
 from dataclasses import dataclass, field
 
+from ..common.encoding import Decoder, Encoder
+
 
 class StoreError(Exception):
     pass
@@ -146,18 +148,23 @@ class MemStore(ObjectStore):
             st = _TxnState(self)
             for op in txn.ops:
                 self._apply(st, op)
-            # commit
-            for cid in st.dead_colls:
-                self._colls.pop(cid, None)
-            for cid in st.new_colls:
-                self._colls.setdefault(cid, {})
-            for (cid, oid), obj in st.objects.items():
-                if cid in st.dead_colls or cid not in self._colls:
-                    continue
-                if obj is None:
-                    self._colls[cid].pop(oid, None)
-                else:
-                    self._colls[cid][oid] = obj
+            self._commit(st)
+
+    def _commit(self, st: _TxnState) -> None:
+        """Merge a validated shadow into live state (all ops applied
+        cleanly).  Shared by the persistent store, which WAL-appends
+        between validation and this merge."""
+        for cid in st.dead_colls:
+            self._colls.pop(cid, None)
+        for cid in st.new_colls:
+            self._colls.setdefault(cid, {})
+        for (cid, oid), obj in st.objects.items():
+            if cid in st.dead_colls or cid not in self._colls:
+                continue
+            if obj is None:
+                self._colls[cid].pop(oid, None)
+            else:
+                self._colls[cid][oid] = obj
 
     def _apply(self, st: _TxnState, op) -> None:
         kind, cid, oid = op[0], op[1], op[2]
@@ -248,3 +255,59 @@ class MemStore(ObjectStore):
             if cid not in self._colls:
                 raise StoreError(f"no collection {cid} (-ENOENT)")
             return sorted(self._colls[cid])
+
+
+# -- transaction serialization ---------------------------------------------
+# (Transaction.h's op encoding role; lives here rather than the
+# messenger so the WAL (kstore) and the wire (msg) share one codec)
+
+_TXN_OPS = {
+    "mkcoll": "cs",
+    "touch": "css",
+    "write": "cssqb",
+    "truncate": "cssq",
+    "setattr": "csssb",
+    "rmattr": "csss",
+    "remove": "css",
+    "rmcoll": "cs",
+}
+# field codes: c=opcode string, s=str, q=int, b=bytes
+_OPCODES = {name: i for i, name in enumerate(sorted(_TXN_OPS))}
+_OPNAMES = {i: name for name, i in _OPCODES.items()}
+
+
+def encode_transaction(e: Encoder, txn: Transaction) -> None:
+    """Serialize the ordered op list (Transaction.h op encoding role)."""
+    e.u32(len(txn.ops))
+    for op in txn.ops:
+        name = op[0]
+        spec = _TXN_OPS[name]
+        e.u8(_OPCODES[name])
+        for kind, val in zip(spec[1:], op[1:]):
+            if kind == "s":
+                e.string(val if val is not None else "")
+            elif kind == "q":
+                e.s64(val)
+            elif kind == "b":
+                e.bytes(val)
+
+
+def decode_transaction(d: Decoder) -> Transaction:
+    txn = Transaction()
+    for _ in range(d.u32()):
+        name = _OPNAMES[d.u8()]
+        spec = _TXN_OPS[name]
+        args = []
+        for kind in spec[1:]:
+            if kind == "s":
+                args.append(d.string())
+            elif kind == "q":
+                args.append(d.s64())
+            elif kind == "b":
+                args.append(d.bytes())
+        if name in ("mkcoll", "rmcoll"):
+            args = args[:1]  # stored as (op, cid, None)
+            txn.ops.append((name, args[0], None))
+        else:
+            txn.ops.append((name, *args))
+    return txn
