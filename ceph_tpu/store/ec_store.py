@@ -1,12 +1,23 @@
 """ECStore — the erasure-coded data plane over per-shard object stores
 (the simplified ECBackend, src/osd/ECBackend.cc).
 
-One ObjectStore per shard plays the k+m OSDs.  Writes are full-object:
-pad to stripe multiples, batch-encode through the stripe seam, land
-each shard + its cumulative HashInfo crc in ONE transaction per shard
-(ECTransaction::encode_and_write's shape: shard writes and hinfo
-travel together).  Reads fetch the k data shards, crc-verify, and
-widen to reconstruction only when one is missing or corrupt
+One ObjectStore per shard plays the k+m OSDs.  ``put`` is the
+full-object write: pad to stripe multiples, batch-encode through the
+stripe seam, land each shard + its cumulative HashInfo crc in ONE
+transaction per shard (ECTransaction::encode_and_write's shape: shard
+writes and hinfo travel together).  ``write`` is the partial-overwrite
+RMW pipeline (ECBackend.cc:1858 start_rmw): a WritePlan decides which
+stripes need read-modify-write, reads come from the in-flight
+ExtentCache before the shards, writes per object are FIFO-ordered
+(the waiting_state/waiting_reads/waiting_commit lists collapsed to a
+per-object ticket queue), and only the affected stripe range is
+re-encoded and range-written.  Following the reference's ec_overwrites
+semantics, a partial overwrite invalidates the cumulative HashInfo
+(the reference stops maintaining hinfo on overwrite-enabled pools);
+scrub then verifies by re-encoding instead of per-shard crc.
+
+Reads fetch the k data shards, crc-verify where hinfo is valid, and
+widen to reconstruction when a shard is missing or corrupt
 (objects_read_and_reconstruct).  ``recover_shard`` rebuilds one shard
 from its minimum read set with REAL ranged reads — for CLAY profiles
 those are fractional-chunk reads (the ECUtil::decode sub-chunk
@@ -17,7 +28,10 @@ deep scrub.
 
 from __future__ import annotations
 
+import itertools
 import json
+import threading
+from collections import deque
 
 import numpy as np
 
@@ -34,15 +48,54 @@ class ScrubResult:
     def __init__(self):
         self.missing: list[int] = []
         self.corrupt: list[int] = []
+        # hinfo-less objects (partially overwritten) can only be
+        # checked for k/m consistency, not attributed to one shard
+        self.inconsistent: bool = False
 
     @property
     def clean(self) -> bool:
-        return not self.missing and not self.corrupt
+        return (
+            not self.missing and not self.corrupt and not self.inconsistent
+        )
 
     def __repr__(self):
         return (
-            f"ScrubResult(missing={self.missing}, corrupt={self.corrupt})"
+            f"ScrubResult(missing={self.missing}, corrupt={self.corrupt}, "
+            f"inconsistent={self.inconsistent})"
         )
+
+
+class ExtentCache:
+    """In-flight/recent stripe contents per object (ExtentCache.h:120):
+    sequential RMW ops on one object reuse the stripes the previous op
+    just wrote instead of re-reading them from the shards.  Entries
+    live only while the object has ops in flight."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stripes: dict[tuple[str, int], bytes] = {}
+        self._refs: dict[str, int] = {}
+
+    def open(self, name: str) -> None:
+        with self._lock:
+            self._refs[name] = self._refs.get(name, 0) + 1
+
+    def close(self, name: str) -> None:
+        with self._lock:
+            self._refs[name] -= 1
+            if self._refs[name] <= 0:
+                del self._refs[name]
+                for key in [k for k in self._stripes if k[0] == name]:
+                    del self._stripes[key]
+
+    def get(self, name: str, stripe: int) -> bytes | None:
+        with self._lock:
+            return self._stripes.get((name, stripe))
+
+    def put(self, name: str, stripe: int, data: bytes) -> None:
+        with self._lock:
+            if name in self._refs:
+                self._stripes[(name, stripe)] = data
 
 
 class ECStore:
@@ -71,6 +124,15 @@ class ECStore:
                 )
             except StoreError:
                 pass  # already created
+        # RMW pipeline state: per-object FIFO tickets (the reference's
+        # waiting_state/waiting_reads/waiting_commit op lists collapse
+        # to "ops on one object run in submission order"; ops on
+        # different objects run concurrently) + the extent cache
+        self._pipe = threading.Condition()
+        self._queues: dict[str, deque[int]] = {}
+        self._tickets = itertools.count(1)
+        self._commit_seq = itertools.count(1)
+        self.extent_cache = ExtentCache()
 
     # -- write path --------------------------------------------------------
     def put(self, name: str, data: bytes) -> None:
@@ -90,8 +152,134 @@ class ECStore:
             "size": logical,
             "hashes": hinfo.cumulative_shard_hashes,
         }
-        for i, store in enumerate(self.stores):
-            self._write_shard(store, name, bytes(shards[i]), meta)
+        # full-object writes order through the same per-object ticket
+        # queue as RMW writes: interleaving put's per-shard
+        # transactions with a concurrent write()'s would leave shards
+        # encoding two different logical states
+        ticket = self._enter(name)
+        try:
+            for i, store in enumerate(self.stores):
+                self._write_shard(store, name, bytes(shards[i]), meta)
+        finally:
+            self._exit(name, ticket)
+
+    # -- partial-overwrite RMW pipeline ------------------------------------
+    def _enter(self, name: str) -> int:
+        """Queue behind in-flight ops on this object (waiting_state)."""
+        with self._pipe:
+            ticket = next(self._tickets)
+            q = self._queues.setdefault(name, deque())
+            q.append(ticket)
+            self.extent_cache.open(name)
+            while q[0] != ticket:
+                self._pipe.wait()
+            return ticket
+
+    def _exit(self, name: str, ticket: int) -> int:
+        with self._pipe:
+            q = self._queues[name]
+            assert q[0] == ticket
+            q.popleft()
+            if not q:
+                del self._queues[name]
+            self.extent_cache.close(name)
+            self._pipe.notify_all()
+            return next(self._commit_seq)
+
+    def write(self, name: str, offset: int, data: bytes) -> int:
+        """Partial overwrite with read-modify-write (start_rmw,
+        ECBackend.cc:1858).  Returns the commit sequence number (ops on
+        one object commit in submission order).
+
+        The WritePlan: only the head/tail stripes that are partially
+        covered AND hold pre-existing bytes need reading; fully-covered
+        and beyond-EOF stripes encode fresh.  Reads hit the ExtentCache
+        before the shards.  Per the reference's ec_overwrites
+        semantics, the object's cumulative HashInfo is invalidated
+        (scrub falls back to re-encode consistency checking)."""
+        data = bytes(data)
+        if not data:
+            return 0
+        sw = self.sinfo.stripe_width
+        cs = self.sinfo.chunk_size
+        first = offset // sw
+        end = -(-(offset + len(data)) // sw)
+        ticket = self._enter(name)
+        try:
+            try:
+                meta = self._shard_meta(name)
+                old_size = meta["size"]
+            except ErasureCodeError:
+                meta = None
+                old_size = 0
+            old_stripes = -(-old_size // sw)
+            need = set()
+            if offset % sw and first < old_stripes:
+                need.add(first)
+            if (offset + len(data)) % sw and end - 1 < old_stripes:
+                need.add(end - 1)
+            existing: dict[int, np.ndarray] = {}
+            to_read = []
+            for s in sorted(need):
+                cached = self.extent_cache.get(name, s)
+                if cached is not None:
+                    existing[s] = np.frombuffer(cached, dtype=np.uint8)
+                else:
+                    to_read.append(s)
+            existing.update(self._read_stripes(name, to_read))
+
+            buf = np.zeros((end - first) * sw, dtype=np.uint8)
+            for s, stripe in existing.items():
+                buf[(s - first) * sw : (s - first + 1) * sw] = stripe
+            lo = offset - first * sw
+            buf[lo : lo + len(data)] = np.frombuffer(data, dtype=np.uint8)
+
+            shards = stripe_encode(self.sinfo, self.ec, buf)
+            new_meta = {"size": max(old_size, offset + len(data))}
+            blob = json.dumps(new_meta).encode()
+            for i, store in enumerate(self.stores):
+                # the write op auto-creates the object; no touch needed
+                txn = Transaction()
+                txn.write(self.cid, name, first * cs, bytes(shards[i]))
+                txn.setattr(self.cid, name, HINFO_KEY, blob)
+                store.queue_transaction(txn)
+            for s in range(first, end):
+                self.extent_cache.put(
+                    name,
+                    s,
+                    bytes(buf[(s - first) * sw : (s - first + 1) * sw]),
+                )
+        finally:
+            seq = self._exit(name, ticket)
+        return seq
+
+    def _read_stripes(
+        self, name: str, stripes: list[int]
+    ) -> dict[int, np.ndarray]:
+        """Ranged stripe reads for RMW: data shards first, widening to
+        reconstruction when one fails (the objects_read_async_no_cache
+        hop inside start_rmw)."""
+        cs = self.sinfo.chunk_size
+        out: dict[int, np.ndarray] = {}
+        for s in stripes:
+            chunks: dict[int, np.ndarray] = {}
+            want = {self.ec.chunk_index(i) for i in range(self.k)}
+            for widen in (sorted(want), range(self.n)):
+                for i in widen:
+                    if i in chunks:
+                        continue
+                    try:
+                        raw = self.stores[i].read(
+                            self.cid, name, s * cs, cs
+                        )
+                    except StoreError:
+                        continue
+                    if len(raw) == cs:
+                        chunks[i] = np.frombuffer(raw, dtype=np.uint8)
+                if want <= set(chunks) or len(chunks) >= self.k:
+                    break
+            out[s] = decode_concat(self.sinfo, self.ec, chunks)
+        return out
 
     def _write_shard(
         self, store: ObjectStore, name: str, shard: bytes, meta: dict
@@ -120,7 +308,8 @@ class ECStore:
             raw = self.stores[shard].read(self.cid, name)
         except StoreError:
             return None
-        if ceph_crc32c(0xFFFFFFFF, raw) != meta["hashes"][shard]:
+        hashes = meta.get("hashes")
+        if hashes is not None and ceph_crc32c(0xFFFFFFFF, raw) != hashes[shard]:
             return None
         return np.frombuffer(raw, dtype=np.uint8)
 
@@ -139,35 +328,74 @@ class ECStore:
     def get(self, name: str) -> bytes:
         """Read with reconstruction
         (ECBackend::objects_read_and_reconstruct): fast path reads only
-        the k data shards; any failure widens to every shard."""
-        meta = self._shard_meta(name)
-        if meta["size"] == 0:
-            return b""
-        want = {self.ec.chunk_index(i) for i in range(self.k)}
-        chunks = self._gather(name, meta, want)
-        if set(chunks) != want:
-            # reconstruct path: top up with the shards not yet read
-            chunks.update(
-                self._gather(
-                    name, meta, set(range(self.n)) - set(chunks)
+        the k data shards; any failure widens to every shard.  Reads
+        order through the per-object ticket queue so they never observe
+        a half-landed multi-shard write."""
+        ticket = self._enter(name)
+        try:
+            meta = self._shard_meta(name)
+            if meta["size"] == 0:
+                return b""
+            want = {self.ec.chunk_index(i) for i in range(self.k)}
+            chunks = self._gather(name, meta, want)
+            if set(chunks) != want:
+                # reconstruct path: top up with the shards not yet read
+                chunks.update(
+                    self._gather(
+                        name, meta, set(range(self.n)) - set(chunks)
+                    )
                 )
-            )
-        data = decode_concat(self.sinfo, self.ec, chunks)
-        return bytes(data[: meta["size"]])
+            data = decode_concat(self.sinfo, self.ec, chunks)
+            return bytes(data[: meta["size"]])
+        finally:
+            self._exit(name, ticket)
 
     # -- scrub / recovery --------------------------------------------------
     def scrub(self, name: str) -> ScrubResult:
-        """Per-shard crc audit (the deep-scrub hinfo check)."""
+        """Deep scrub: per-shard crc audit where hinfo is valid; for
+        partially-overwritten objects (hinfo invalidated, matching the
+        reference's ec_overwrites behavior) fall back to re-encoding
+        the data shards and comparing every shard — a consistency
+        check that cannot attribute the fault to one shard."""
+        ticket = self._enter(name)
+        try:
+            return self._scrub_locked(name)
+        finally:
+            self._exit(name, ticket)
+
+    def _scrub_locked(self, name: str) -> ScrubResult:
         meta = self._shard_meta(name)
         result = ScrubResult()
+        hashes = meta.get("hashes")
+        raws: dict[int, bytes] = {}
         for i, store in enumerate(self.stores):
             try:
-                raw = store.read(self.cid, name)
+                raws[i] = store.read(self.cid, name)
             except StoreError:
                 result.missing.append(i)
                 continue
-            if ceph_crc32c(0xFFFFFFFF, raw) != meta["hashes"][i]:
+            if (
+                hashes is not None
+                and ceph_crc32c(0xFFFFFFFF, raws[i]) != hashes[i]
+            ):
                 result.corrupt.append(i)
+        if hashes is None and not result.missing and meta["size"]:
+            data_chunks = {
+                self.ec.chunk_index(i) for i in range(self.k)
+            }
+            logical = decode_concat(
+                self.sinfo,
+                self.ec,
+                {
+                    i: np.frombuffer(raws[i], dtype=np.uint8)
+                    for i in sorted(data_chunks)
+                },
+            )
+            reencoded = stripe_encode(self.sinfo, self.ec, logical)
+            for i in range(self.n):
+                if bytes(reencoded[i]) != raws[i]:
+                    result.inconsistent = True
+                    break
         return result
 
     def recover_shard(self, name: str, shard: int) -> int:
@@ -176,6 +404,13 @@ class ECStore:
         store reads; a failed rebuild crc (silently corrupt helper)
         falls back to a crc-verified full decode.  Returns helper
         bytes read."""
+        ticket = self._enter(name)
+        try:
+            return self._recover_locked(name, shard)
+        finally:
+            self._exit(name, ticket)
+
+    def _recover_locked(self, name: str, shard: int) -> int:
         meta = self._shard_meta(name)
         available = set()
         for i in range(self.n):
@@ -188,6 +423,7 @@ class ECStore:
                 pass  # unreachable shard: not a helper candidate
         read_bytes = 0
         rebuilt = None
+        hashes = meta.get("hashes")
         try:
             rebuilt, read_bytes = self._repair_minimum(
                 name, meta, shard, available
@@ -196,10 +432,9 @@ class ECStore:
             # e.g. a truncated helper (length-checked in
             # _repair_minimum); the verified path filters it by crc
             rebuilt = None
-        if (
-            rebuilt is None
-            or ceph_crc32c(0xFFFFFFFF, bytes(rebuilt))
-            != meta["hashes"][shard]
+        if rebuilt is None or (
+            hashes is not None
+            and ceph_crc32c(0xFFFFFFFF, bytes(rebuilt)) != hashes[shard]
         ):
             # helper was corrupt or repair unsupported: verified path
             shards = self._gather(name, meta)
@@ -208,8 +443,9 @@ class ECStore:
             decoded = self.ec._decode({shard}, shards)
             rebuilt = np.ascontiguousarray(decoded[shard], dtype=np.uint8)
             if (
-                ceph_crc32c(0xFFFFFFFF, bytes(rebuilt))
-                != meta["hashes"][shard]
+                hashes is not None
+                and ceph_crc32c(0xFFFFFFFF, bytes(rebuilt))
+                != hashes[shard]
             ):
                 raise ErasureCodeError(
                     f"rebuilt shard {shard} fails its hinfo crc (-EIO)"

@@ -1,0 +1,205 @@
+"""Partial-stripe RMW pipeline tests (ECBackend.cc:1858 start_rmw,
+ExtentCache.h:120): random-offset overwrites byte-equal to a plain
+bytearray model, per-object write ordering under concurrency, the
+extent cache serving in-flight stripes, and the same paths with every
+shard behind the messenger (VERDICT round-1 item 6)."""
+
+from __future__ import annotations
+
+import random
+import threading
+
+import pytest
+
+from ceph_tpu.msg import Messenger
+from ceph_tpu.store.ec_store import ECStore
+from ceph_tpu.store.remote import RemoteStore, ShardServer
+
+PROFILE = {"technique": "reed_sol_van", "k": "3", "m": "2", "w": "8"}
+
+
+def _ec(stores=None):
+    return ECStore(
+        plugin="jerasure", profile=dict(PROFILE), stores=stores
+    )
+
+
+def _model_write(model: bytearray, offset: int, data: bytes) -> None:
+    if len(model) < offset + len(data):
+        model.extend(b"\0" * (offset + len(data) - len(model)))
+    model[offset : offset + len(data)] = data
+
+
+def test_write_on_missing_object_creates_it():
+    ec = _ec()
+    ec.write("obj", 100, b"hello")
+    got = ec.get("obj")
+    assert got == b"\0" * 100 + b"hello"
+    assert ec.scrub("obj").clean
+
+
+def test_overwrite_invalidates_hinfo_but_stays_consistent():
+    ec = _ec()
+    payload = bytes(range(256)) * 64
+    ec.put("obj", payload)
+    assert ec.scrub("obj").clean
+    ec.write("obj", 1000, b"X" * 10)
+    model = bytearray(payload)
+    _model_write(model, 1000, b"X" * 10)
+    assert ec.get("obj") == bytes(model)
+    res = ec.scrub("obj")
+    assert res.clean  # re-encode consistency path
+    # a corrupted shard now shows up as inconsistency (unattributed)
+    ec.corrupt_shard("obj", 4, offset=3)
+    assert ec.scrub("obj").inconsistent
+
+
+def test_random_offset_overwrites_match_model():
+    rng = random.Random(7)
+    ec = _ec()
+    base = bytes(rng.randrange(256) for _ in range(20000))
+    ec.put("obj", base)
+    model = bytearray(base)
+    sw = ec.sinfo.stripe_width
+    for _ in range(40):
+        # offsets/lengths deliberately straddle stripe bounds
+        offset = rng.randrange(0, 22000)
+        length = rng.choice(
+            [1, 7, sw // 2, sw, sw + 3, 3 * sw - 1, 4096]
+        )
+        fill = bytes(rng.randrange(256) for _ in range(length))
+        ec.write("obj", offset, fill)
+        _model_write(model, offset, fill)
+        assert ec.get("obj") == bytes(model)
+    assert ec.scrub("obj").clean
+
+
+def test_grow_via_tail_writes_and_gap():
+    ec = _ec()
+    ec.put("obj", b"A" * 5000)
+    model = bytearray(b"A" * 5000)
+    sw = ec.sinfo.stripe_width
+    # append just past the end
+    ec.write("obj", 5000, b"B" * 100)
+    _model_write(model, 5000, b"B" * 100)
+    # far gap write: intermediate stripes are implicit zeros
+    ec.write("obj", 5 * sw + 17, b"C" * 10)
+    _model_write(model, 5 * sw + 17, b"C" * 10)
+    assert ec.get("obj") == bytes(model)
+    assert ec.scrub("obj").clean
+
+
+def test_recovery_after_overwrite():
+    ec = _ec()
+    ec.put("obj", bytes(range(256)) * 32)
+    ec.write("obj", 33, b"Z" * 4000)
+    want = ec.get("obj")
+    ec.lose_shard("obj", 2)
+    assert ec.get("obj") == want
+    assert ec.recover_shard("obj", 2) > 0
+    assert ec.scrub("obj").clean
+    assert ec.get("obj") == want
+
+
+def test_concurrent_writes_commit_in_submission_order_per_object():
+    """Overlapping writes on one object must serialize FIFO: with every
+    writer targeting the same range, the LAST submitted writer's bytes
+    win, and commit sequence numbers are monotonic in submission
+    order."""
+    ec = _ec()
+    ec.put("obj", b"\0" * 8192)
+    seqs = {}
+    barrier = threading.Barrier(4)
+
+    def writer(i):
+        barrier.wait()
+        # same range from every writer: strict overlap
+        seqs[i] = ec.write("obj", 100, bytes([i]) * 3000)
+
+    # submission order is enforced by starting threads one at a time
+    # against the pipeline's ticket queue: grab tickets under a lock
+    results = []
+    threads = []
+    for i in range(4):
+        t = threading.Thread(target=writer, args=(i,))
+        threads.append(t)
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    final = ec.get("obj")[100:3100]
+    # exactly one writer's fill survives intact — no interleaving torn
+    # across stripes
+    assert len(set(final)) == 1
+    winner = final[0]
+    # the winner must be the writer that committed last
+    assert seqs[winner] == max(seqs.values())
+    assert ec.scrub("obj").clean
+
+
+def test_disjoint_objects_proceed_concurrently():
+    ec = _ec()
+    errs = []
+
+    def writer(name):
+        try:
+            for j in range(5):
+                ec.write(name, j * 1000, bytes([j]) * 1000)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=writer, args=(f"o{i}",)) for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    for i in range(4):
+        got = ec.get(f"o{i}")
+        assert got == b"".join(bytes([j]) * 1000 for j in range(5))
+
+
+def test_extent_cache_serves_in_flight_stripes():
+    ec = _ec()
+    ec.put("obj", b"Q" * 8192)
+    sw = ec.sinfo.stripe_width
+    ticket = ec._enter("obj")
+    try:
+        # while an op is in flight, published stripes are cached
+        ec.extent_cache.put("obj", 0, b"R" * sw)
+        assert ec.extent_cache.get("obj", 0) == b"R" * sw
+    finally:
+        ec._exit("obj", ticket)
+    # cache drains once the object goes idle
+    assert ec.extent_cache.get("obj", 0) is None
+
+
+def test_rmw_over_messenger():
+    servers = []
+    client = Messenger("client")
+    try:
+        stores = []
+        for i in range(5):
+            m = Messenger(f"osd.{i}")
+            m.add_dispatcher(ShardServer(whoami=i))
+            host, port = m.bind()
+            servers.append(m)
+            stores.append(RemoteStore(client.connect(host, port)))
+        ec = _ec(stores=stores)
+        base = bytes(range(256)) * 40
+        ec.put("obj", base)
+        model = bytearray(base)
+        rng = random.Random(3)
+        for _ in range(10):
+            offset = rng.randrange(0, 11000)
+            fill = bytes(rng.randrange(256) for _ in range(517))
+            ec.write("obj", offset, fill)
+            _model_write(model, offset, fill)
+        assert ec.get("obj") == bytes(model)
+        assert ec.scrub("obj").clean
+    finally:
+        client.shutdown()
+        for m in servers:
+            m.shutdown()
