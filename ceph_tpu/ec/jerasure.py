@@ -423,6 +423,50 @@ class BlaumRoth(Liberation):
         self.bitmatrix = bm
 
 
+class Liber8tion(Liberation):
+    """RAID6 for w=8: m=2, k <= 8, packetsize multiple of 8
+    (ErasureCodeJerasure.cc ErasureCodeJerasureLiber8tion — w is forced
+    to 8 and m to 2 regardless of the profile, like the reference).
+
+    Construction note for parity review: upstream's bitmatrix is
+    Plank's search-found minimal-density table (71 ones), shipped only
+    inside the jerasure submodule that is absent from the reference
+    mount, so the exact table cannot be reproduced here.  This class
+    keeps the technique's parameter slot and RAID6 geometry with a
+    provably-MDS low-density construction instead: Q block j is the
+    GF(2) bitmatrix of multiply-by-``c_j`` over GF(2^8), with the
+    constants chosen as the eight nonzero bytes whose multiply
+    bitmatrices are sparsest (111 ones total vs the 71 bound).  MDS is
+    immediate: every block is invertible (c_j != 0) and every pairwise
+    sum is multiply-by-(c_i ^ c_j) != 0, hence invertible.  Chunk
+    bytes therefore do NOT match upstream liber8tion output —
+    deviation tracked in docs/PARITY.md alongside blaum_roth.
+    """
+
+    DEFAULT_K, DEFAULT_M, DEFAULT_W = 2, 2, 8
+    technique = "liber8tion"
+    # The 8 sparsest multiply-by-c bitmatrices over GF(2^8)/0x11d,
+    # sorted by density then value (ones: 8,11,11,14,14,17,18,18).
+    CONSTANTS = (1, 2, 142, 4, 71, 8, 70, 173)
+
+    def parse(self, profile):
+        profile["w"] = "8"  # forced, reference parse() does the same
+        super().parse(profile)
+
+    def _check_kw(self):
+        if self.k > self.w:
+            raise ErasureCodeError(f"k={self.k} must be <= w={self.w}")
+
+    def prepare(self):
+        k, w = self.k, self.w
+        bm = np.zeros((2 * w, k * w), dtype=np.uint8)
+        for j in range(k):
+            bm[:w, j * w : (j + 1) * w] = np.eye(w, dtype=np.uint8)
+            cmat = np.array([[self.CONSTANTS[j]]], dtype=np.uint32)
+            bm[w:, j * w : (j + 1) * w] = gf.jerasure_bitmatrix(cmat, w)
+        self.bitmatrix = bm
+
+
 @register("jerasure")
 class ErasureCodePluginJerasure(ErasureCodePlugin):
     TECHNIQUES = {
@@ -432,11 +476,8 @@ class ErasureCodePluginJerasure(ErasureCodePlugin):
         "cauchy_good": CauchyGood,
         "liberation": Liberation,
         "blaum_roth": BlaumRoth,
+        "liber8tion": Liber8tion,
     }
-    # liber8tion (w=8 RAID6): its bitmatrix is a published table with
-    # no generating formula and the jerasure submodule carrying it is
-    # absent from the reference mount — gap tracked in docs/PARITY.md;
-    # the reference dispatch is ErasureCodePluginJerasure.cc:40-57.
 
     def make(self, profile: ErasureCodeProfile):
         technique = profile.get("technique", "reed_sol_van")

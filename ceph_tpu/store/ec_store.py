@@ -97,6 +97,14 @@ class ExtentCache:
             if name in self._refs:
                 self._stripes[(name, stripe)] = data
 
+    def invalidate(self, name: str) -> None:
+        """Drop every cached stripe of ``name`` — a full-object write
+        replaced the content, so queued RMW ops must re-read (the
+        reference ExtentCache is repopulated by the write itself)."""
+        with self._lock:
+            for key in [k for k in self._stripes if k[0] == name]:
+                del self._stripes[key]
+
 
 class ECStore:
     def __init__(
@@ -160,6 +168,9 @@ class ECStore:
         try:
             for i, store in enumerate(self.stores):
                 self._write_shard(store, name, bytes(shards[i]), meta)
+            # queued RMW ops must not reuse stripes of the replaced
+            # content
+            self.extent_cache.invalidate(name)
         finally:
             self._exit(name, ticket)
 
@@ -202,8 +213,10 @@ class ECStore:
             return 0
         sw = self.sinfo.stripe_width
         cs = self.sinfo.chunk_size
-        first = offset // sw
-        end = -(-(offset + len(data)) // sw)
+        start, span = self.sinfo.offset_len_to_stripe_bounds(
+            offset, len(data)
+        )
+        first, end = start // sw, (start + span) // sw
         ticket = self._enter(name)
         try:
             try:
@@ -212,7 +225,16 @@ class ECStore:
             except ErasureCodeError:
                 meta = None
                 old_size = 0
-            old_stripes = -(-old_size // sw)
+            if meta is not None:
+                # overwriting a degraded object would auto-create
+                # short zero-filled shards and lose data that is still
+                # reconstructible — recover missing/truncated shards
+                # first (the wait_for_degraded_object barrier before
+                # ECBackend::submit_transaction)
+                self._recover_degraded(name, old_size)
+            old_stripes = (
+                self.sinfo.logical_to_next_stripe_offset(old_size) // sw
+            )
             need = set()
             if offset % sw and first < old_stripes:
                 need.add(first)
@@ -252,6 +274,24 @@ class ECStore:
         finally:
             seq = self._exit(name, ticket)
         return seq
+
+    def _recover_degraded(self, name: str, old_size: int) -> None:
+        """Rebuild any missing/truncated shard before a partial
+        overwrite lands range writes on it."""
+        expected = (
+            self.sinfo.logical_to_next_chunk_offset(old_size)
+        )
+        if expected == 0:
+            # empty object: every shard is empty or auto-creates
+            # uniformly; nothing to rebuild
+            return
+        for i, store in enumerate(self.stores):
+            try:
+                if store.stat(self.cid, name) == expected:
+                    continue
+            except StoreError:
+                pass
+            self._recover_locked(name, i)
 
     def _read_stripes(
         self, name: str, stripes: list[int]

@@ -19,6 +19,7 @@ JERASURE_TECHNIQUES = [
     ("cauchy_orig", {"k": "4", "m": "2", "w": "8", "packetsize": "8"}),
     ("cauchy_good", {"k": "4", "m": "2", "w": "8", "packetsize": "8"}),
     ("liberation", {"k": "4", "m": "2", "w": "7", "packetsize": "8"}),
+    ("liber8tion", {"k": "6", "m": "2", "packetsize": "8"}),
 ]
 
 
@@ -186,6 +187,50 @@ def test_blaum_roth_exhaustive_erasures():
             np.testing.assert_array_equal(
                 decoded[i], encoded[i], str(lost)
             )
+
+
+def test_liber8tion_exhaustive_erasures():
+    """liber8tion (w=8 RAID6) recovers any double erasure at full
+    k=8 — the MDS property of the multiply-by-constant construction
+    (block sums are multiply-by-(c_i^c_j), always invertible)."""
+    from itertools import combinations
+
+    ec = registry_instance().factory(
+        "jerasure",
+        ErasureCodeProfile(
+            technique="liber8tion", k="8", m="2", packetsize="8"
+        ),
+    )
+    data = np.random.default_rng(11).integers(
+        0, 256, 8 * 8 * 8 * 4, dtype=np.uint8
+    ).tobytes()
+    encoded = ec.encode(set(range(10)), data)
+    for lost in combinations(range(10), 2):
+        avail = {i: c for i, c in encoded.items() if i not in lost}
+        decoded = ec._decode(set(lost), avail)
+        for i in lost:
+            np.testing.assert_array_equal(
+                decoded[i], encoded[i], str(lost)
+            )
+
+
+def test_liber8tion_forces_w8_m2():
+    """The reference's parse forces w=8 and m=2 regardless of profile
+    (ErasureCodeJerasure.cc ErasureCodeJerasureLiber8tion::parse)."""
+    ec = registry_instance().factory(
+        "jerasure",
+        ErasureCodeProfile(
+            technique="liber8tion", k="4", m="3", w="7", packetsize="8"
+        ),
+    )
+    assert ec.w == 8 and ec.m == 2
+    with pytest.raises(ErasureCodeError):
+        registry_instance().factory(
+            "jerasure",
+            ErasureCodeProfile(
+                technique="liber8tion", k="9", m="2", packetsize="8"
+            ),
+        )  # k > w
 
 
 def test_blaum_roth_w_validation():

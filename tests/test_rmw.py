@@ -203,3 +203,42 @@ def test_rmw_over_messenger():
         client.shutdown()
         for m in servers:
             m.shutdown()
+
+
+def test_overwrite_of_degraded_object_recovers_first():
+    """A partial overwrite of an object with a missing shard must not
+    auto-create a short zero-filled shard (data loss from a state that
+    was still recoverable) — the degraded shard is rebuilt before the
+    range write lands (the wait_for_degraded_object barrier)."""
+    ec = _ec()
+    sw = ec.sinfo.stripe_width
+    data = bytes(range(256)) * (5 * sw // 256 + 1)
+    data = data[: 5 * sw]
+    ec.put("obj", data)
+    ec.lose_shard("obj", 0)
+    ec.write("obj", 2 * sw, b"Z" * 100)
+    model = bytearray(data)
+    model[2 * sw : 2 * sw + 100] = b"Z" * 100
+    assert ec.get("obj") == bytes(model)
+    assert ec.scrub("obj").clean
+
+
+def test_put_invalidates_extent_cache_for_queued_writes():
+    """put() replaces the whole object: stripes cached by earlier RMW
+    ops must not be served to writes queued behind the put.  The cache
+    is held open (as queued ops do) so entries survive between ops —
+    before the fix, W2's head-stripe read returned W1-era bytes."""
+    ec = _ec()
+    sw = ec.sinfo.stripe_width
+    ec.extent_cache.open("o")  # a queued op keeps refs > 0
+    try:
+        ec.put("o", b"\0" * (4 * sw))
+        ec.write("o", 10, b"\x11" * 8)  # populates cache stripes
+        ec.put("o", b"\x42" * (4 * sw))  # replaces content
+        ec.write("o", sw + 5, b"\x33" * 8)  # must not see stale cache
+    finally:
+        ec.extent_cache.close("o")
+    model = bytearray(b"\x42" * (4 * sw))
+    model[sw + 5 : sw + 13] = b"\x33" * 8
+    assert ec.get("o") == bytes(model)
+    assert ec.scrub("o").clean
