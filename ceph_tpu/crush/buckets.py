@@ -120,10 +120,12 @@ def bucket_straw2_choose(
     makes the device kernel a pure vmap+argmax."""
     weights = bucket.item_weights
     ids = bucket.items
-    if arg is not None and arg.weight_set is not None:
+    # empty weight_set/ids behave like none at all (the C's
+    # weight_set_positions == 0 / ids_size == 0 cases)
+    if arg is not None and arg.weight_set:
         pos = min(position, len(arg.weight_set) - 1)
         weights = arg.weight_set[pos]
-    if arg is not None and arg.ids is not None:
+    if arg is not None and arg.ids:
         ids = arg.ids
     high = 0
     high_draw = 0

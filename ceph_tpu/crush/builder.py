@@ -118,6 +118,10 @@ class CrushMap:
     rules: list[Rule | None] = field(default_factory=list)
     max_devices: int = 0
     choose_args: dict[int, ChooseArg] = field(default_factory=dict)
+    # device classes (CrushWrapper class_map / class_bucket)
+    class_map: dict[int, int] = field(default_factory=dict)
+    class_names: dict[int, str] = field(default_factory=dict)
+    class_bucket: dict[int, dict[int, int]] = field(default_factory=dict)
     # name maps (CrushWrapper name_map/type_map)
     type_names: dict[int, str] = field(
         default_factory=lambda: {0: "osd", 1: "host", 2: "rack", 3: "root"}
@@ -133,6 +137,124 @@ class CrushMap:
         """Record a structural/weight mutation (invalidates compiled
         caches).  Call after mutating buckets/rules/tunables directly."""
         self.mutation += 1
+
+    def set_choose_args(self, args: dict[int, ChooseArg]) -> None:
+        """Install per-bucket straw2 overrides (the balancer's
+        crush-compat weight-set path, CrushWrapper.h:1447) and
+        invalidate compiled caches."""
+        self.choose_args = dict(args)
+        self.touch()
+
+    # -- device classes (CrushWrapper class_map + shadow trees) ------------
+    def get_class_id(self, name: str, create: bool = False) -> int:
+        for cid, n in self.class_names.items():
+            if n == name:
+                return cid
+        if not create:
+            raise KeyError(f"device class {name!r} does not exist")
+        cid = max(self.class_names, default=-1) + 1
+        self.class_names[cid] = name
+        return cid
+
+    def set_item_class(self, item: int, class_name: str) -> None:
+        """Tag a device with a class (CrushWrapper::set_item_class);
+        shadow trees pick it up at the next populate_classes()."""
+        self.class_map[item] = self.get_class_id(class_name, create=True)
+        self.touch()
+
+    def _roots(self) -> list[int]:
+        """Bucket ids not referenced by any other non-shadow bucket."""
+        shadows = {
+            c for per in self.class_bucket.values() for c in per.values()
+        }
+        referenced: set[int] = set()
+        for bid, b in self.buckets.items():
+            if bid in shadows:
+                continue
+            referenced.update(i for i in b.items if i < 0)
+        return [
+            bid
+            for bid in self.buckets
+            if bid not in shadows and bid not in referenced
+        ]
+
+    def populate_classes(self) -> None:
+        """(Re)build the per-class shadow hierarchies
+        (CrushWrapper::populate_classes → device_class_clone,
+        CrushWrapper.cc:2681): for every class and every root, a clone
+        named ``<name>~<class>`` holding only that class's devices,
+        with sub-bucket clones always included (possibly empty) and
+        weights rolled up from the included items.  Existing clones
+        keep their ids across rebuilds (the old_class_bucket reuse)."""
+        live = {
+            c
+            for item, c in self.class_map.items()
+            if item >= 0
+        }
+        for per in self.class_bucket.values():
+            for cls, cid_clone in per.items():
+                self.buckets.pop(cid_clone, None)
+                if cls not in live:
+                    # retired class: its clone ids stay RESERVED in
+                    # class_bucket (never reallocated — a rule may
+                    # still TAKE them, and the class may return) but
+                    # the shadow buckets and names disappear from the
+                    # map until then
+                    self.item_names.pop(cid_clone, None)
+        roots = self._roots()
+        for cls in sorted(live):
+            for root in sorted(roots, reverse=True):
+                self._device_class_clone(root, cls)
+        self.touch()
+
+    def _device_class_clone(self, original_id: int, cls: int) -> int:
+        existing = self.class_bucket.get(original_id, {}).get(cls)
+        if existing is not None and existing in self.buckets:
+            return existing
+        orig = self.buckets[original_id]
+        items: list[int] = []
+        weights: list[int] = []
+        for item, w in zip(orig.items, orig.item_weights):
+            if item >= 0:
+                if self.class_map.get(item) == cls:
+                    items.append(item)
+                    weights.append(w)
+            else:
+                child = self._device_class_clone(item, cls)
+                items.append(child)
+                weights.append(self.buckets[child].weight)
+        if existing is not None:
+            new_id = existing
+        else:
+            # like the C's used_ids set: never hand out an id reserved
+            # by ANY clone (even one whose bucket is mid-rebuild)
+            reserved = {
+                c
+                for per in self.class_bucket.values()
+                for c in per.values()
+            }
+            new_id = min(set(self.buckets) | reserved, default=0) - 1
+            while new_id in self.buckets or new_id in reserved:
+                new_id -= 1
+        if orig.alg == CRUSH_BUCKET_UNIFORM and weights:
+            # a uniform clone keeps the per-item weight invariant
+            weights = [weights[0]] * len(weights)
+        self.add_bucket(
+            orig.alg,
+            orig.type,
+            items,
+            weights,
+            id=new_id,
+            name=(
+                f"{self.item_names[original_id]}~{self.class_names[cls]}"
+                if original_id in self.item_names
+                else None
+            ),
+            hash=orig.hash,
+        )
+        self.class_bucket.setdefault(original_id, {})[cls] = new_id
+        self.class_map[new_id] = cls
+        return new_id
 
     def _name_to_item(self, name: str) -> int:
         for item, n in self.item_names.items():
@@ -223,12 +345,19 @@ class CrushMap:
         """CrushWrapper::add_simple_rule_at semantics: TAKE root,
         CHOOSELEAF over the failure domain (or CHOOSE osd for a flat
         domain), EMIT; indep rules prepend SET_CHOOSELEAF_TRIES 5 and
-        SET_CHOOSE_TRIES 100.  Device classes need shadow trees (not
-        yet built — tracked in docs/PARITY.md)."""
+        SET_CHOOSE_TRIES 100.  A device class resolves the TAKE to the
+        class's shadow root ``<root>~<class>`` (built on demand)."""
         assert mode in ("firstn", "indep"), mode
         if device_class:
-            raise NotImplementedError("device-class shadow trees")
-        root = self._name_to_item(root_name)
+            self.get_class_id(device_class)  # must exist
+            shadow = f"{root_name}~{device_class}"
+            try:
+                root = self._name_to_item(shadow)
+            except KeyError:
+                self.populate_classes()
+                root = self._name_to_item(shadow)
+        else:
+            root = self._name_to_item(root_name)
         dtype = self._type_id(failure_domain) if failure_domain else 0
         steps: list[RuleStep] = []
         if mode == "indep":

@@ -143,6 +143,11 @@ class CompiledMap:
     """
 
     row_pack: jnp.ndarray  # (nb, 3*sz+3) f32: items|w_hi|w_lo|size|alg|id
+    # choose_args rendering (crush.h:248-293): per-position straw2
+    # weight replacements + hash-id remaps, position-clamped at compile
+    # time.  None when the map carries no choose_args (zero overhead).
+    args_pack: jnp.ndarray | None  # (nb, P*2*sz + sz) f32: aw_hi|aw_lo|aids
+    arg_positions: int  # P (max weight_set positions; 0 without args)
     types_f: jnp.ndarray  # (nb,) f32 bucket types
     bidx_f: jnp.ndarray  # (max_neg,) f32: (-1-id) -> row, -1 for gaps
     ln_tbl1: jnp.ndarray  # (129, 4) f32: rh_hi, rh_lo, lh_hi, lh_lo
@@ -179,9 +184,6 @@ def compile_map(cmap) -> CompiledMap:
                 f"bucket {b.id} alg {b.alg}: device kernel supports "
                 "straw2 and uniform buckets"
             )
-    if cmap.choose_args:
-        raise UnsupportedMap("choose_args not yet in the device kernel")
-
     nb = len(cmap.buckets)
     sz = max(max(b.size for b in cmap.buckets.values()), 1)
     items = np.zeros((nb, sz), dtype=np.int64)
@@ -209,6 +211,62 @@ def compile_map(cmap) -> CompiledMap:
         if b.weight >= 1 << 32:
             raise UnsupportedMap("bucket weight >= 2^32")
 
+    # choose_args → dense per-position weight/id tables.  The C only
+    # consults args in the straw2 chooser (crush_bucket_choose,
+    # mapper.c:387-418), so args on other bucket algs are ignored, and
+    # the position clamp (get_choose_arg_weights, mapper.c:311-317) is
+    # baked in by replicating each bucket's last weight-set row.
+    P = 0
+    args_pack = None
+    if cmap.choose_args:
+        P = max(
+            (
+                len(a.weight_set)
+                for a in cmap.choose_args.values()
+                if a.weight_set
+            ),
+            default=1,
+        )
+        aw = np.repeat(weights[:, None, :], P, axis=1)  # (nb, P, sz)
+        aids = items.copy()
+        for bid, arg in cmap.choose_args.items():
+            b = cmap.buckets.get(bid)
+            if b is None or b.alg != CRUSH_BUCKET_STRAW2:
+                continue  # the C consults args only for straw2
+            row = int(np.nonzero(ids == bid)[0][0])
+            # empty weight_set falls back to bucket weights (the C's
+            # weight_set_positions == 0 case)
+            if arg.weight_set:
+                for p in range(P):
+                    ws = arg.weight_set[min(p, len(arg.weight_set) - 1)]
+                    if len(ws) != b.size:
+                        raise UnsupportedMap(
+                            f"choose_arg weight_set size {len(ws)} != "
+                            f"bucket {b.id} size {b.size}"
+                        )
+                    if any(w >= 1 << 32 for w in ws):
+                        raise UnsupportedMap("choose_arg weight >= 2^32")
+                    aw[row, p, : b.size] = ws
+            if arg.ids is not None:
+                if len(arg.ids) != b.size:
+                    raise UnsupportedMap(
+                        f"choose_arg ids size {len(arg.ids)} != "
+                        f"bucket {b.id} size {b.size}"
+                    )
+                if any(abs(i) >= 1 << 24 for i in arg.ids):
+                    raise UnsupportedMap(
+                        "choose_arg id magnitude >= 2^24"
+                    )
+                aids[row, : b.size] = arg.ids
+        args_pack = np.concatenate(
+            [
+                (aw >> 16).reshape(nb, P * sz).astype(np.float32),
+                (aw & 0xFFFF).reshape(nb, P * sz).astype(np.float32),
+                aids.astype(np.float32),
+            ],
+            axis=1,
+        )
+
     rules = []
     for rule in cmap.rules:
         rules.append(None if rule is None else _compile_rule(rule))
@@ -233,6 +291,8 @@ def compile_map(cmap) -> CompiledMap:
     )
     return CompiledMap(
         row_pack=jnp.asarray(row_pack),
+        args_pack=None if args_pack is None else jnp.asarray(args_pack),
+        arg_positions=P,
         types_f=jnp.asarray(types.astype(np.float32)),
         bidx_f=jnp.asarray(bidx.astype(np.float32)),
         ln_tbl1=jnp.asarray(ln_tbl1),
@@ -360,8 +420,12 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
         bid = jnp.round(row[3 * SZ + 2]).astype(jnp.int32)
         return ids, wf, size, alg, bid
 
-    def straw2_draw(ids, wf, size, x, r):
+    def straw2_draw(hash_ids, ids, wf, size, x, r):
         """One straw2 draw-argmax (mapper.c:361-384).
+
+        ``hash_ids`` feed the hash (choose_args may remap them,
+        bucket_straw2_choose mapper.c:363-384); the returned item is
+        always from the bucket's real ``ids``.
 
         draw_i = -floor(L_i/w_i) computed in float64: L < 2^48 and
         w < 2^32 are f64-exact, the quotient estimate is off by at most
@@ -370,7 +434,7 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
         u = (
             _hash3(
                 jnp.uint32(x),
-                ids.astype(jnp.uint32),
+                hash_ids.astype(jnp.uint32),
                 jnp.uint32(r),
             )
             & jnp.uint32(0xFFFF)
@@ -429,20 +493,48 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
             jnp.where(jnp.arange(SZ) == s, ids, 0)
         ).astype(jnp.int32)
 
-    def dispatch_draw(ids, wf, size, alg, bid, x, r):
+    P = cm.arg_positions
+
+    def load_args(bidx_row, pos):
+        """choose_args row for a bucket: position-selected straw2
+        weights + hash-id remap (both equal the bucket's own tables
+        for argless buckets, so one code path serves every map)."""
+        arow = _lookup(bidx_row, NB, cm.args_pack)
+        poh = (
+            jnp.arange(P) == jnp.clip(pos, 0, P - 1)
+        ).astype(jnp.float32)
+        hi = jnp.matmul(
+            poh, arow[: P * SZ].reshape(P, SZ), precision=HIP
+        )
+        lo = jnp.matmul(
+            poh, arow[P * SZ : 2 * P * SZ].reshape(P, SZ), precision=HIP
+        )
+        awf = hi.astype(jnp.float64) * 65536.0 + lo.astype(jnp.float64)
+        aids = jnp.round(arow[2 * P * SZ :]).astype(jnp.int32)
+        return aids, awf
+
+    def dispatch_draw(bidx_row, ids, wf, size, alg, bid, x, r, pos):
         """crush_bucket_choose over already-loaded bucket data; the
         perm path only compiles into maps that contain uniform
-        buckets."""
-        item = straw2_draw(ids, wf, size, x, r)
+        buckets, the choose_args path only into maps that carry
+        choose_args."""
+        if cm.args_pack is not None:
+            hash_ids, awf = load_args(bidx_row, pos)
+        else:
+            hash_ids, awf = ids, wf
+        item = straw2_draw(hash_ids, ids, awf, size, x, r)
         if cm.has_uniform:
             uni = perm_draw(ids, size, bid, x, r)
             item = jnp.where(alg == CRUSH_BUCKET_UNIFORM, uni, item)
         return item
 
-    def bucket_draw(bidx_row, x, r):
+    def bucket_draw(bidx_row, x, r, pos):
         """Load + draw; returns (item, bucket_size)."""
         ids, wf, size, alg, bid = load_bucket(bidx_row)
-        return dispatch_draw(ids, wf, size, alg, bid, x, r), size
+        return (
+            dispatch_draw(bidx_row, ids, wf, size, alg, bid, x, r, pos),
+            size,
+        )
 
     def row_of(item):
         """Bucket row for a (negative) item; -1 if invalid."""
@@ -514,7 +606,11 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
                 sub_r = jnp.int32(0)
             r = jnp.where(in_leaf, leaf_rep + sub_r + lftotal, r_outer)
 
-            item, bsize = bucket_draw(cur_row, x, r)
+            # choose_args position: the C passes the running outpos at
+            # every firstn draw (mapper.c:526-530), and the chooseleaf
+            # recursion re-enters with the same outpos (:578-588), so
+            # one register serves both modes
+            item, bsize = bucket_draw(cur_row, x, r, outpos)
             empty = bsize == 0
             target = jnp.where(in_leaf, 0, ttype)
             found, desc, hard_bad, nrow = classify(item, target)
@@ -664,7 +760,14 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
                 slot + stride * ftotal,
             )
 
-            item = dispatch_draw(ids, wf, bsize, alg, bid, x, r)
+            # choose_args position: indep outer draws pass the FRAME
+            # outpos — constant 0 from do_rule (mapper.c:736-739) — and
+            # the leaf recursion enters with outpos=rep (:790-794), so
+            # leaf draws use the slot index
+            pos = jnp.where(in_leaf, slot, jnp.int32(0))
+            item = dispatch_draw(
+                cur_row, ids, wf, bsize, alg, bid, x, r, pos
+            )
             empty = bsize == 0
             target = jnp.where(in_leaf, 0, ttype)
             found, desc, hard_bad, nrow = classify(item, target)

@@ -168,10 +168,11 @@ class ECStore:
         try:
             for i, store in enumerate(self.stores):
                 self._write_shard(store, name, bytes(shards[i]), meta)
-            # queued RMW ops must not reuse stripes of the replaced
-            # content
-            self.extent_cache.invalidate(name)
         finally:
+            # queued RMW ops must not reuse stripes of the replaced
+            # content — even when a shard write failed partway, the
+            # cached stripes no longer match what landed
+            self.extent_cache.invalidate(name)
             self._exit(name, ticket)
 
     # -- partial-overwrite RMW pipeline ------------------------------------
@@ -271,6 +272,11 @@ class ECStore:
                     s,
                     bytes(buf[(s - first) * sw : (s - first + 1) * sw]),
                 )
+        except BaseException:
+            # shards may hold a half-landed write; cached stripes from
+            # earlier ops no longer describe what is on disk
+            self.extent_cache.invalidate(name)
+            raise
         finally:
             seq = self._exit(name, ticket)
         return seq

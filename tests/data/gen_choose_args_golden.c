@@ -1,0 +1,121 @@
+/* Golden-vector generator: builds maps with builder.c, runs
+   crush_do_rule with choose_args, prints mappings. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "crush/crush.h"
+#include "crush/builder.h"
+#include "crush/mapper.h"
+#include "crush/hash.h"
+
+static void add_rules(struct crush_map *map, int root, int domain_type) {
+    /* rule 0: firstn; rule 1: indep with tries overrides */
+    struct crush_rule *r0 = crush_make_rule(3, 0, 1, 1, 10);
+    crush_rule_set_step(r0, 0, CRUSH_RULE_TAKE, root, 0);
+    crush_rule_set_step(r0, 1,
+        domain_type ? CRUSH_RULE_CHOOSELEAF_FIRSTN : CRUSH_RULE_CHOOSE_FIRSTN,
+        0, domain_type);
+    crush_rule_set_step(r0, 2, CRUSH_RULE_EMIT, 0, 0);
+    crush_add_rule(map, r0, 0);
+    struct crush_rule *r1 = crush_make_rule(5, 0, 3, 1, 10);
+    crush_rule_set_step(r1, 0, CRUSH_RULE_SET_CHOOSELEAF_TRIES, 5, 0);
+    crush_rule_set_step(r1, 1, CRUSH_RULE_SET_CHOOSE_TRIES, 100, 0);
+    crush_rule_set_step(r1, 2, CRUSH_RULE_TAKE, root, 0);
+    crush_rule_set_step(r1, 3,
+        domain_type ? CRUSH_RULE_CHOOSELEAF_INDEP : CRUSH_RULE_CHOOSE_INDEP,
+        0, domain_type);
+    crush_rule_set_step(r1, 4, CRUSH_RULE_EMIT, 0, 0);
+    crush_add_rule(map, r1, 1);
+}
+
+int main(void) {
+    /* two-level straw2: 5 hosts x 4 devices */
+    struct crush_map *map = crush_create();
+    map->choose_local_tries = 0;
+    map->choose_local_fallback_tries = 0;
+    map->choose_total_tries = 50;
+    map->chooseleaf_descend_once = 1;
+    map->chooseleaf_vary_r = 1;
+    map->chooseleaf_stable = 1;
+    int hosts[5];
+    for (int h = 0; h < 5; h++) {
+        int items[4]; int weights[4];
+        for (int i = 0; i < 4; i++) {
+            items[i] = h * 4 + i;
+            weights[i] = 0x10000 + i * 0x4000;
+        }
+        struct crush_bucket *b = crush_make_bucket(map,
+            CRUSH_BUCKET_STRAW2, CRUSH_HASH_RJENKINS1, 1, 4, items, weights);
+        int id; crush_add_bucket(map, 0, b, &id);
+        hosts[h] = id;
+    }
+    int hw[5];
+    for (int h = 0; h < 5; h++)
+        hw[h] = map->buckets[-1-hosts[h]]->weight;
+    struct crush_bucket *rootb = crush_make_bucket(map,
+        CRUSH_BUCKET_STRAW2, CRUSH_HASH_RJENKINS1, 3, 5, hosts, hw);
+    int rootid; crush_add_bucket(map, 0, rootb, &rootid);
+    add_rules(map, rootid, 1);
+    crush_finalize(map);
+
+    /* choose_args: bucket rows: max_buckets entries */
+    struct crush_choose_arg *args = calloc(map->max_buckets, sizeof(*args));
+    /* host 0 (row -1-hosts[0]): weight_set with 2 positions */
+    {
+        int row = -1 - hosts[0];
+        static __u32 w0[4], w1[4];
+        for (int i = 0; i < 4; i++) { w0[i] = 0x8000 + i*0x2000; w1[i] = 0x20000 - i*0x3000; }
+        static struct crush_weight_set ws[2];
+        ws[0].weights = w0; ws[0].size = 4;
+        ws[1].weights = w1; ws[1].size = 4;
+        args[row].weight_set = ws; args[row].weight_set_positions = 2;
+    }
+    /* host 2: ids remap */
+    {
+        int row = -1 - hosts[2];
+        static __s32 ids[4] = { 1008, 1009, 1010, 1011 };
+        args[row].ids = ids; args[row].ids_size = 4;
+    }
+    /* root: weight_set single position, skew host weights */
+    {
+        int row = -1 - rootid;
+        static __u32 w0[5];
+        for (int i = 0; i < 5; i++) w0[i] = 0x40000 + i*0x10000;
+        static struct crush_weight_set ws[1];
+        ws[0].weights = w0; ws[0].size = 5;
+        args[row].weight_set = ws; args[row].weight_set_positions = 1;
+    }
+    struct crush_choose_arg_map cam = { args, (unsigned)map->max_buckets };
+
+    int nw = 20;
+    __u32 weight[20];
+    for (int i = 0; i < nw; i++) {
+        weight[i] = 0x10000;
+        if (i % 7 == 3) weight[i] = 0x8000;
+        if (i % 11 == 5) weight[i] = 0;
+    }
+    void *cwin = malloc(crush_work_size(map, 10));
+    int result[10];
+    for (int rule = 0; rule < 2; rule++) {
+        for (int nrep = 2; nrep <= 4; nrep++) {
+            for (int x = 0; x < 100; x++) {
+                crush_init_workspace(map, cwin);
+                int n = crush_do_rule(map, rule, x, result, nrep,
+                                      weight, nw, cwin, cam.args);
+                printf("ca %d %d %d [", rule, nrep, x);
+                for (int i = 0; i < n; i++)
+                    printf(i ? ",%d" : "%d", result[i]);
+                printf("]\n");
+                /* and without choose_args for contrast */
+                crush_init_workspace(map, cwin);
+                n = crush_do_rule(map, rule, x, result, nrep,
+                                  weight, nw, cwin, NULL);
+                printf("nc %d %d %d [", rule, nrep, x);
+                for (int i = 0; i < n; i++)
+                    printf(i ? ",%d" : "%d", result[i]);
+                printf("]\n");
+            }
+        }
+    }
+    return 0;
+}

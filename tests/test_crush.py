@@ -336,3 +336,197 @@ def test_add_simple_rule_and_find_rule():
     assert len(res) == 3
     placed = [r for r in res if r != CRUSH_ITEM_NONE]
     assert len({p // 2 for p in placed}) == len(placed)
+
+
+# -- choose_args golden (weight-set + id-remap maps) -----------------------
+
+
+def build_choose_args_scenario():
+    """The map tests/data/gen_choose_args_golden.c builds: two-level
+    straw2 (5 hosts x 4 devices), host0 carrying a 2-position
+    weight_set, host2 an ids remap, and the root a 1-position
+    weight_set — the mgr balancer's crush-compat shapes
+    (crush.h:248-293)."""
+    from ceph_tpu.crush.types import ChooseArg
+
+    m = CrushMap(tunables=JEWEL)
+    hosts = []
+    for h in range(5):
+        items = [h * 4 + i for i in range(4)]
+        weights = [0x10000 + i * 0x4000 for i in range(4)]
+        hosts.append(m.add_bucket(CRUSH_BUCKET_STRAW2, 1, items, weights))
+    hw = [m.buckets[b].weight for b in hosts]
+    root = m.add_bucket(CRUSH_BUCKET_STRAW2, 3, hosts, hw)
+    _add_two_rules(m, root, 1)
+    m.set_choose_args({
+        hosts[0]: ChooseArg(
+            weight_set=[
+                [0x8000 + i * 0x2000 for i in range(4)],
+                [0x20000 - i * 0x3000 for i in range(4)],
+            ]
+        ),
+        hosts[2]: ChooseArg(ids=[1008, 1009, 1010, 1011]),
+        root: ChooseArg(
+            weight_set=[[0x40000 + i * 0x10000 for i in range(5)]]
+        ),
+    })
+    return m
+
+
+def iter_choose_args_golden():
+    import re
+
+    golden = gzip.open(
+        DATA / "crush_choose_args_golden.txt.gz", "rt"
+    ).read().splitlines()
+    for line in golden:
+        tag, rule, nrep, x, res = re.match(
+            r"(\w+) (\d+) (\d+) (\d+) \[(.*)\]", line
+        ).groups()
+        want = [int(v) for v in res.split(",")] if res else []
+        yield tag, int(rule), int(nrep), int(x), want
+
+
+def test_choose_args_matches_reference_c():
+    """Oracle vs compiled reference C over weight-set/id-remap maps —
+    both with choose_args applied ('ca' lines) and without ('nc'),
+    anchoring the position semantics (firstn: running outpos; indep:
+    frame outpos, i.e. slot in the leaf recursion)."""
+    from ceph_tpu.crush.mapper import crush_do_rule
+
+    m = build_choose_args_scenario()
+    weight = reference_weight_vector(20)
+    checked = 0
+    for tag, rule, nrep, x, want in iter_choose_args_golden():
+        ca = m.choose_args if tag == "ca" else {}
+        got = crush_do_rule(m, rule, x, nrep, weight, choose_args=ca)
+        assert got == want, (tag, rule, nrep, x, want, got)
+        checked += 1
+    assert checked == 1200
+
+
+# -- device classes (shadow trees) -----------------------------------------
+
+
+def build_class_map():
+    """3 hosts x 4 devices, alternating hdd/ssd devices; per-class
+    rules via shadow trees (CrushWrapper.cc:2681 device_class_clone)."""
+    m = CrushMap(tunables=JEWEL)
+    hosts = []
+    for h in range(3):
+        items = [h * 4 + i for i in range(4)]
+        weights = [0x10000 + i * 0x4000 for i in range(4)]
+        hosts.append(
+            m.add_bucket(
+                CRUSH_BUCKET_STRAW2, 1, items, weights,
+                name=f"host{h}",
+            )
+        )
+    hw = [m.buckets[b].weight for b in hosts]
+    root = m.add_bucket(CRUSH_BUCKET_STRAW2, 3, hosts, hw, name="default")
+    for dev in range(12):
+        m.set_item_class(dev, "hdd" if dev % 2 == 0 else "ssd")
+    return m, root
+
+
+def test_device_class_shadow_trees():
+    m, root = build_class_map()
+    r_hdd = m.add_simple_rule("hdd_rule", "default", "host",
+                              device_class="hdd")
+    r_ssd = m.add_simple_rule("ssd_rule", "default", "host",
+                              device_class="ssd", mode="indep")
+    # shadow hierarchy exists with rolled-up weights
+    sroot = m._name_to_item("default~hdd")
+    assert sroot in m.buckets
+    hdd_weight = sum(
+        0x10000 + i * 0x4000 for i in range(0, 4, 2)
+    ) * 3
+    assert m.buckets[sroot].weight == hdd_weight
+    # mappings stay inside the class
+    for x in range(64):
+        for rule, parity in ((r_hdd, 0), (r_ssd, 1)):
+            out = m.do_rule(rule, x, 2)
+            assert out, (rule, x)
+            for dev in out:
+                if dev >= 0:
+                    assert dev % 2 == parity, (rule, x, out)
+
+
+def test_device_class_rebuild_keeps_ids_and_tracks_weights():
+    m, root = build_class_map()
+    m.add_simple_rule("hdd_rule", "default", "host", device_class="hdd")
+    sroot = m._name_to_item("default~hdd")
+    before = dict(m.class_bucket)
+    # reweight a device and rebuild: same shadow ids, new rollup
+    h0 = m._name_to_item("host0")
+    m.buckets[h0].item_weights[0] = 0x40000
+    m.buckets[h0].weight = sum(m.buckets[h0].item_weights)
+    m.touch()
+    m.populate_classes()
+    assert m.class_bucket == before
+    sh0 = m.class_bucket[h0][m.get_class_id("hdd")]
+    assert m.buckets[sh0].item_weights[0] == 0x40000
+
+
+def test_device_class_on_device_kernel():
+    """Shadow trees are plain straw2 buckets: the device kernel maps
+    them with no special casing, oracle-equal."""
+    import os
+
+    import numpy as np
+
+    from ceph_tpu.crush.jaxmap import batch_do_rule, compile_map
+
+    m, root = build_class_map()
+    r_hdd = m.add_simple_rule("hdd_rule", "default", "host",
+                              device_class="hdd")
+    cm = compile_map(m)
+    xs = np.arange(128, dtype=np.int64)
+    got, counts = batch_do_rule(cm, r_hdd, xs, 2)
+    got, counts = np.asarray(got), np.asarray(counts)
+    for x in range(128):
+        expect = m.do_rule(r_hdd, x, 2)
+        assert got[x, : counts[x]].tolist() == expect, x
+
+
+def test_device_class_retag_never_aliases_clone_ids():
+    """Retiring a class keeps its clone ids reserved (a rule may still
+    TAKE them; the class may return) — a new class must never be
+    handed a retired class's ids, and a returning class reclaims its
+    own (the C's used_ids discipline, CrushWrapper.cc:2744-2752)."""
+    m, root = build_class_map()
+    m.populate_classes()
+    ssd_root = m._name_to_item("default~ssd")
+    for d in range(1, 12, 2):
+        m.set_item_class(d, "nvme")
+    m.populate_classes()
+    nvme_root = m._name_to_item("default~nvme")
+    assert nvme_root != ssd_root
+    assert ssd_root not in m.buckets  # retired tree leaves the map
+    m.set_item_class(1, "ssd")
+    m.populate_classes()
+    assert m._name_to_item("default~ssd") == ssd_root  # id reclaimed
+    cid_s, cid_n = m.get_class_id("ssd"), m.get_class_id("nvme")
+    h0 = m._name_to_item("host0")
+    assert m.buckets[m.class_bucket[h0][cid_s]].items == [1]
+    assert m.buckets[m.class_bucket[h0][cid_n]].items == [3]
+
+
+def test_choose_args_empty_weight_set_falls_back():
+    """ChooseArg(weight_set=[]) behaves like no weight replacement
+    (the C's weight_set_positions == 0), on oracle and device."""
+    import numpy as np
+
+    from ceph_tpu.crush.jaxmap import batch_do_rule, compile_map
+    from ceph_tpu.crush.types import ChooseArg
+
+    m = _scenarios()[1]
+    root = min(m.buckets)
+    m.set_choose_args({root: ChooseArg(weight_set=[])})
+    cm = compile_map(m)  # must not crash
+    xs = np.arange(64, dtype=np.int64)
+    got, counts = batch_do_rule(cm, 0, xs, 3)
+    got, counts = np.asarray(got), np.asarray(counts)
+    for x in range(64):
+        expect = m.do_rule(0, x, 3)
+        assert got[x, : counts[x]].tolist() == expect, x
