@@ -1,13 +1,19 @@
-"""crushtool --test equivalent (src/tools/crushtool.cc:200-231,535 and
-src/crush/CrushTester.{h,cc}).
+"""crushtool equivalent (src/tools/crushtool.cc:200-231,535 and
+src/crush/CrushTester.{h,cc}, src/crush/CrushCompiler.cc).
 
-Maps x ∈ [min-x, max-x) through a rule and reports utilization,
+Modes:
+- ``-c map.txt -o out``     compile a text crushmap to reference binary
+- ``-d map.bin [-o out]``   decompile a reference binary to text
+- ``-i map.bin --test``     test a real (reference-format) binary map
+- ``--build --test``        test a synthetic straw2 hierarchy
+
+--test maps x ∈ [min-x, max-x) through a rule and reports utilization,
 chi-squared uniformity and bad mappings — plus mappings/sec, which is
-the PG-mapping benchmark surface (BASELINE.md).  Instead of compiled
-crushmap files the map comes from a synthetic hierarchy spec
-(``--build``) mirroring crushtool's --build mode.
+the PG-mapping benchmark surface (BASELINE.md).
 
-Backends: ``jax`` (batched device kernel) or ``oracle`` (exact scalar).
+Backends: ``jax`` (batched device kernel) or ``oracle`` (exact scalar);
+jax falls back to the oracle on maps outside the device kernel's scope
+(e.g. list/tree/straw buckets).
 """
 
 from __future__ import annotations
@@ -75,7 +81,15 @@ def build_hierarchy(
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(prog="crushtool", description=__doc__)
-    p.add_argument("--test", action="store_true", required=True)
+    p.add_argument("--test", action="store_true")
+    p.add_argument("-c", "--compile", metavar="MAP.TXT",
+                   help="compile text crushmap to reference binary")
+    p.add_argument("-d", "--decompile", metavar="MAP.BIN",
+                   help="decompile reference binary crushmap to text")
+    p.add_argument("-i", "--input", metavar="MAP.BIN",
+                   help="reference binary crushmap to --test")
+    p.add_argument("-o", "--output", metavar="FILE",
+                   help="output file for -c/-d")
     p.add_argument("--build", metavar="OSDS:PER_HOST[:HOSTS_PER_RACK]",
                    default="64:4",
                    help="synthesize a straw2 hierarchy")
@@ -87,9 +101,12 @@ def parse_args(argv=None):
     p.add_argument("--show-utilization", action="store_true")
     p.add_argument("--show-statistics", action="store_true")
     p.add_argument("--show-bad-mappings", action="store_true")
-    p.add_argument("--weight", type=float, action="append", default=[],
+    p.add_argument("--weight", type=str, action="append", default=[],
                    metavar="OSD:W", help="reweight osd, e.g. 3:0.5")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if not (args.test or args.compile or args.decompile or args.input):
+        p.error("no action specified (use -c, -d, -i and/or --test)")
+    return args
 
 
 def run_test(m: CrushMap, args) -> dict:
@@ -98,14 +115,30 @@ def run_test(m: CrushMap, args) -> dict:
     num_osds = m.max_devices
     weights = [0x10000] * num_osds
     for spec in args.weight:
-        osd, w = str(spec).split(":") if isinstance(spec, str) else (None, None)
-        weights[int(osd)] = int(float(w) * 0x10000)
+        osd, sep, w = spec.partition(":")
+        if not sep:
+            raise SystemExit(
+                f"crushtool: --weight expects OSD:W, got {spec!r}"
+            )
+        osd = int(osd)
+        if osd >= len(weights):
+            # ids past max_devices are tolerated like the reference's
+            # weight map (crushtool.cc:822); they can't match anyway
+            weights.extend([0x10000] * (osd + 1 - len(weights)))
+        weights[osd] = int(float(w) * 0x10000)
 
     t0 = time.perf_counter()
-    if args.backend == "jax":
+    backend = args.backend
+    if backend == "jax":
         from ..crush import jaxmap
 
-        cm = jaxmap.compile_map(m)
+        try:
+            cm = jaxmap.compile_map(m)
+        except jaxmap.UnsupportedMap as e:
+            print(f"# map outside device kernel ({e}); using oracle",
+                  file=sys.stderr)
+            backend = "oracle"
+    if backend == "jax":
         res, counts = jaxmap.batch_do_rule(
             cm, args.rule, xs, args.num_rep, weights
         )
@@ -128,6 +161,7 @@ def run_test(m: CrushMap, args) -> dict:
         res = np.asarray(rows, dtype=np.int64)
         counts = np.asarray(counts)
         elapsed = time.perf_counter() - t0
+    args.backend = backend  # report the backend that actually ran
 
     valid = (res != CRUSH_ITEM_NONE) & (
         np.arange(args.num_rep)[None, :] < counts[:, None]
@@ -156,10 +190,35 @@ def run_test(m: CrushMap, args) -> dict:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
-    parts = [int(v) for v in args.build.split(":")]
-    num_osds, per_host = parts[0], parts[1]
-    hpr = parts[2] if len(parts) > 2 else 0
-    m = build_hierarchy(num_osds, per_host, hpr)
+    from ..crush import compiler
+
+    if args.compile:
+        with open(args.compile) as f:
+            m = compiler.compile_crushmap(f.read())
+        blob = compiler.encode_crushmap(m)
+        out = args.output or (args.compile + ".compiled")
+        with open(out, "wb") as f:
+            f.write(blob)
+    elif args.decompile:
+        with open(args.decompile, "rb") as f:
+            m = compiler.decode_crushmap(f.read())
+        text = compiler.decompile_crushmap(m)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+        return 0
+    elif args.input:
+        with open(args.input, "rb") as f:
+            m = compiler.decode_crushmap(f.read())
+    else:
+        parts = [int(v) for v in args.build.split(":")]
+        num_osds, per_host = parts[0], parts[1]
+        hpr = parts[2] if len(parts) > 2 else 0
+        m = build_hierarchy(num_osds, per_host, hpr)
+    if not args.test:
+        return 0
     stats = run_test(m, args)
     print(
         f"rule {args.rule} x [{args.min_x},{args.max_x}) num_rep "
