@@ -542,17 +542,12 @@ def decompile_crushmap(m: CrushMap) -> str:
             out.append(line + "\n")
 
     out.append("\n# types\n")
-    remaining = len(m.type_names)
-    i = 0
-    while remaining:
-        name = m.type_names.get(i)
-        if name is None:
-            if i == 0:
-                out.append("type 0 osd\n")
-        else:
-            remaining -= 1
-            out.append(f"type {i} {name}\n")
-        i += 1
+    # iterate the map directly (scanning i upward until every name is
+    # seen would hang on a negative key a malformed blob can carry)
+    if 0 not in m.type_names:
+        out.append("type 0 osd\n")
+    for i in sorted(m.type_names):
+        out.append(f"type {i} {m.type_names[i]}\n")
 
     out.append("\n# buckets\n")
     shadows = {

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ec.backend import register_backend
+from . import packed_gf
 from .gf_matmul import (
     bitmatrix_packet_regions,
     gf_matrix_regions,
@@ -22,12 +23,43 @@ from .gf_matmul import (
 )
 
 
+def _on_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=512)
+def _host_bitmatrix(key: bytes, shape: tuple, w: int):
+    """Host-side bitmatrix + packed-kernel eligibility, cached per
+    matrix (no device upload, no per-call supports() recompute)."""
+    from .. import gf
+
+    mat = np.frombuffer(key, dtype=np.int64).reshape(shape)
+    bm = gf.jerasure_bitmatrix(mat, w)
+    return bm, packed_gf.supports(bm, w)
+
+
+def _host_bm(matrix: np.ndarray, w: int):
+    mat = np.ascontiguousarray(matrix, dtype=np.int64)
+    return _host_bitmatrix(mat.tobytes(), mat.shape, w)
+
+
 class JaxBackend:
     name = "jax"
 
     def matrix_regions(
         self, matrix: np.ndarray, regions: np.ndarray, w: int
     ) -> np.ndarray:
+        if w == 8 and _on_tpu() and regions.shape[1] % 4 == 0:
+            bm_np, ok = _host_bm(matrix, w)
+            if ok:
+                return np.asarray(
+                    packed_gf.packed_bitmatrix_regions(bm_np, regions)
+                )
         bm = matrix_to_device_bitmatrix(matrix, w)
         out = gf_matrix_regions(bm, jnp.asarray(regions), w=w)
         return np.asarray(out)
@@ -53,7 +85,16 @@ class JaxBackend:
         """Batched (B, k, chunk) → (B, m, chunk); numpy in, numpy out.
 
         Device-array pipelines that want to keep results on-chip call
-        ``ops.gf_matmul.gf_matrix_stripes`` directly instead."""
+        ``ops.gf_matmul.gf_matrix_stripes`` (or
+        ``ops.packed_gf.packed_matrix_stripes``) directly instead."""
+        stripes = np.ascontiguousarray(stripes, dtype=np.uint8)
+        b, _k, chunk = stripes.shape
+        if w == 8 and _on_tpu() and (b * chunk) % 4 == 0:
+            bm_np, ok = _host_bm(matrix, w)
+            if ok:
+                return np.asarray(
+                    packed_gf.packed_matrix_stripes(bm_np, stripes)
+                )
         bm = matrix_to_device_bitmatrix(matrix, w)
         return np.asarray(gf_matrix_stripes(bm, jnp.asarray(stripes), w=w))
 
