@@ -31,7 +31,6 @@ from __future__ import annotations
 import itertools
 import json
 import threading
-from collections import deque
 
 import numpy as np
 
@@ -40,29 +39,9 @@ from ..ec.interface import ErasureCodeError
 from ..ec.stripe import HashInfo, StripeInfo, decode_concat, encode as stripe_encode
 from ..native import ceph_crc32c
 from .objectstore import MemStore, ObjectStore, StoreError, Transaction
+from .pg_util import ObjectOpQueue, ScrubResult
 
 HINFO_KEY = "hinfo_key"  # the xattr name the reference uses
-
-
-class ScrubResult:
-    def __init__(self):
-        self.missing: list[int] = []
-        self.corrupt: list[int] = []
-        # hinfo-less objects (partially overwritten) can only be
-        # checked for k/m consistency, not attributed to one shard
-        self.inconsistent: bool = False
-
-    @property
-    def clean(self) -> bool:
-        return (
-            not self.missing and not self.corrupt and not self.inconsistent
-        )
-
-    def __repr__(self):
-        return (
-            f"ScrubResult(missing={self.missing}, corrupt={self.corrupt}, "
-            f"inconsistent={self.inconsistent})"
-        )
 
 
 class ExtentCache:
@@ -136,9 +115,7 @@ class ECStore:
         # waiting_state/waiting_reads/waiting_commit op lists collapse
         # to "ops on one object run in submission order"; ops on
         # different objects run concurrently) + the extent cache
-        self._pipe = threading.Condition()
-        self._queues: dict[str, deque[int]] = {}
-        self._tickets = itertools.count(1)
+        self._opq = ObjectOpQueue()
         self._commit_seq = itertools.count(1)
         self.extent_cache = ExtentCache()
 
@@ -178,25 +155,19 @@ class ECStore:
     # -- partial-overwrite RMW pipeline ------------------------------------
     def _enter(self, name: str) -> int:
         """Queue behind in-flight ops on this object (waiting_state)."""
-        with self._pipe:
-            ticket = next(self._tickets)
-            q = self._queues.setdefault(name, deque())
-            q.append(ticket)
-            self.extent_cache.open(name)
-            while q[0] != ticket:
-                self._pipe.wait()
-            return ticket
+        return self._opq.enter(
+            name, on_enter=lambda: self.extent_cache.open(name)
+        )
 
     def _exit(self, name: str, ticket: int) -> int:
-        with self._pipe:
-            q = self._queues[name]
-            assert q[0] == ticket
-            q.popleft()
-            if not q:
-                del self._queues[name]
+        seq = []
+
+        def on_exit():
             self.extent_cache.close(name)
-            self._pipe.notify_all()
-            return next(self._commit_seq)
+            seq.append(next(self._commit_seq))
+
+        self._opq.exit(name, ticket, on_exit=on_exit)
+        return seq[0]
 
     def write(self, name: str, offset: int, data: bytes) -> int:
         """Partial overwrite with read-modify-write (start_rmw,
