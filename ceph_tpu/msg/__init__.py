@@ -28,6 +28,18 @@ from .message import (
     MECSubWrite,
     MECSubWriteReply,
     MOSDMap,
+    MOSDOp,
+    MOSDOpReply,
+    MOSDRepOp,
+    MOSDRepOpReply,
+    MPGActivate,
+    MPGLogReply,
+    MPGLogReq,
+    MPGNotify,
+    MPGPull,
+    MPGPush,
+    MPGPushReply,
+    MPGQuery,
     MPing,
     Message,
     MessageError,
@@ -43,6 +55,18 @@ __all__ = [
     "MECSubWrite",
     "MECSubWriteReply",
     "MOSDMap",
+    "MOSDOp",
+    "MOSDOpReply",
+    "MOSDRepOp",
+    "MOSDRepOpReply",
+    "MPGActivate",
+    "MPGLogReply",
+    "MPGLogReq",
+    "MPGNotify",
+    "MPGPull",
+    "MPGPush",
+    "MPGPushReply",
+    "MPGQuery",
     "MPing",
     "Message",
     "MessageError",

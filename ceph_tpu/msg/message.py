@@ -350,3 +350,283 @@ class MOSDBoot(Message):
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MOSDBoot":
         return cls(osd=d.s32(), addr=d.string())
+
+
+# -- OSD daemon: client ops, replication, peering, recovery ----------------
+
+# client op kinds (the do_osd_ops switch, PrimaryLogPG.cc)
+OSD_OP_WRITEFULL = 0
+OSD_OP_WRITE = 1
+OSD_OP_READ = 2
+OSD_OP_DELETE = 3
+OSD_OP_STAT = 4
+
+
+@register_message
+@dataclass
+class MOSDOp(Message):
+    """Client → primary object op (MOSDOp): targeted at a pg, carrying
+    one op (the reference batches a vector; one is enough for the
+    librados surface here)."""
+
+    TYPE = 12
+    pool: int = 0
+    pgid: str = ""
+    oid: str = ""
+    op: int = OSD_OP_READ
+    offset: int = 0
+    length: int = 0
+    data: bytes = b""
+    epoch: int = 0  # client's map epoch (primary checks staleness)
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s64(self.pool).string(self.pgid).string(self.oid)
+        e.u8(self.op).u64(self.offset).s64(self.length)
+        e.bytes(self.data).u32(self.epoch)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MOSDOp":
+        return cls(
+            pool=d.s64(), pgid=d.string(), oid=d.string(),
+            op=d.u8(), offset=d.u64(), length=d.s64(),
+            data=d.bytes(), epoch=d.u32(),
+        )
+
+
+@register_message
+@dataclass
+class MOSDOpReply(Message):
+    """Primary → client result (MOSDOpReply)."""
+
+    TYPE = 13
+    ok: bool = True
+    error: str = ""
+    data: bytes = b""
+    size: int = 0
+    epoch: int = 0  # primary's epoch (client refreshes when ahead)
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.bool(self.ok).string(self.error).bytes(self.data)
+        e.u64(self.size).u32(self.epoch)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MOSDOpReply":
+        return cls(
+            ok=d.bool(), error=d.string(), data=d.bytes(),
+            size=d.u64(), epoch=d.u32(),
+        )
+
+
+@register_message
+@dataclass
+class MOSDRepOp(Message):
+    """Primary → replica: one transaction + its log entry (MOSDRepOp /
+    sub_op_modify: data and pg log ride the same atomic apply)."""
+
+    TYPE = 14
+    pgid: str = ""
+    epoch: int = 0
+    txn: "Transaction" = None  # type: ignore[assignment]
+    entry_blob: bytes = b""  # encoded LogEntry
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.pgid).u32(self.epoch)
+        encode_transaction(e, self.txn)
+        e.bytes(self.entry_blob)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MOSDRepOp":
+        return cls(
+            pgid=d.string(), epoch=d.u32(),
+            txn=decode_transaction(d), entry_blob=d.bytes(),
+        )
+
+
+@register_message
+@dataclass
+class MOSDRepOpReply(Message):
+    TYPE = 15
+    from_osd: int = 0
+    ok: bool = True
+    error: str = ""
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.from_osd).bool(self.ok).string(self.error)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MOSDRepOpReply":
+        return cls(from_osd=d.s32(), ok=d.bool(), error=d.string())
+
+
+@register_message
+@dataclass
+class MPGQuery(Message):
+    """Primary → peer: send me your pg_info (the GetInfo query,
+    PeeringState's pg_query_t)."""
+
+    TYPE = 16
+    pgid: str = ""
+    epoch: int = 0
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.pgid).u32(self.epoch)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MPGQuery":
+        return cls(pgid=d.string(), epoch=d.u32())
+
+
+@register_message
+@dataclass
+class MPGNotify(Message):
+    """Peer → primary: pg_info (MNotifyRec role)."""
+
+    TYPE = 17
+    from_osd: int = 0
+    info_blob: bytes = b""  # encoded PGInfo ('' = pg unknown here)
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.from_osd).bytes(self.info_blob)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MPGNotify":
+        return cls(from_osd=d.s32(), info_blob=d.bytes())
+
+
+@register_message
+@dataclass
+class MPGLogReq(Message):
+    """Primary → authoritative peer: entries after ``since`` (the
+    GetLog request)."""
+
+    TYPE = 18
+    pgid: str = ""
+    epoch: int = 0
+    since: tuple = (0, 0)
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.pgid).u32(self.epoch)
+        e.u32(self.since[0]).u64(self.since[1])
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MPGLogReq":
+        return cls(
+            pgid=d.string(), epoch=d.u32(), since=(d.u32(), d.u64())
+        )
+
+
+@register_message
+@dataclass
+class MPGLogReply(Message):
+    """Authoritative peer → primary: log entries + info (MLogRec)."""
+
+    TYPE = 19
+    from_osd: int = 0
+    info_blob: bytes = b""
+    entry_blobs: list = field(default_factory=list)
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.from_osd).bytes(self.info_blob)
+        e.list(self.entry_blobs, lambda e2, b: e2.bytes(b))
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MPGLogReply":
+        return cls(
+            from_osd=d.s32(), info_blob=d.bytes(),
+            entry_blobs=d.list(lambda d2: d2.bytes()),
+        )
+
+
+@register_message
+@dataclass
+class MPGPush(Message):
+    """Primary → recovering peer: one whole object at a version (the
+    recovery push, ReplicatedBackend::prep_push; None data = the
+    object was deleted)."""
+
+    TYPE = 20
+    pgid: str = ""
+    epoch: int = 0
+    oid: str = ""
+    exists: bool = True
+    data: bytes = b""
+    attrs: dict = field(default_factory=dict)
+    entry_blob: bytes = b""  # the log entry that names this version
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.pgid).u32(self.epoch).string(self.oid)
+        e.bool(self.exists).bytes(self.data)
+        e.map(
+            self.attrs,
+            lambda e2, k: e2.string(k),
+            lambda e2, v: e2.bytes(v),
+        )
+        e.bytes(self.entry_blob)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MPGPush":
+        return cls(
+            pgid=d.string(), epoch=d.u32(), oid=d.string(),
+            exists=d.bool(), data=d.bytes(),
+            attrs=d.map(lambda d2: d2.string(), lambda d2: d2.bytes()),
+            entry_blob=d.bytes(),
+        )
+
+
+@register_message
+@dataclass
+class MPGPushReply(Message):
+    TYPE = 21
+    from_osd: int = 0
+    ok: bool = True
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.from_osd).bool(self.ok)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MPGPushReply":
+        return cls(from_osd=d.s32(), ok=d.bool())
+
+
+@register_message
+@dataclass
+class MPGActivate(Message):
+    """Primary → peer: peering finished — adopt the authoritative log
+    suffix and go active (the MOSDPGLog activation message)."""
+
+    TYPE = 22
+    pgid: str = ""
+    epoch: int = 0
+    info_blob: bytes = b""  # primary's (authoritative) info
+    entry_blobs: list = field(default_factory=list)
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.pgid).u32(self.epoch).bytes(self.info_blob)
+        e.list(self.entry_blobs, lambda e2, b: e2.bytes(b))
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MPGActivate":
+        return cls(
+            pgid=d.string(), epoch=d.u32(), info_blob=d.bytes(),
+            entry_blobs=d.list(lambda d2: d2.bytes()),
+        )
+
+
+@register_message
+@dataclass
+class MPGPull(Message):
+    """Recovering primary → authoritative peer: send me this object
+    (the pull side of recovery, ReplicatedBackend::prepare_pull);
+    answered by a tid-paired MPGPush."""
+
+    TYPE = 23
+    pgid: str = ""
+    epoch: int = 0
+    oid: str = ""
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.pgid).u32(self.epoch).string(self.oid)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MPGPull":
+        return cls(pgid=d.string(), epoch=d.u32(), oid=d.string())

@@ -1,0 +1,714 @@
+"""OSD daemon — boot, map subscription, per-PG peering, replicated
+I/O, log-based recovery, heartbeats (src/osd/OSD.cc, PeeringState.cc,
+PrimaryLogPG.cc — the daemon core VERDICT §2.4 called out).
+
+Shape vs the reference:
+
+- Boot: bind the messenger, connect the MonClient, announce with
+  MOSDBoot; the monitor marks the OSD up and a new map epoch arrives
+  by subscription (OSD::start_boot → _send_boot).
+- Dispatch: the messenger read loop enqueues ops onto a worker queue
+  (the op_shardedwq role, OSD.cc:9612 enqueue_op) — nested sub-op
+  RPC must never run on the loop thread.  Pure-answer messages
+  (MPGQuery/MPGLogReq/MPGPull/MOSDRepOp) are served inline.
+- PGs: every map epoch, the worker walks pool PGs, instantiates the
+  ones this OSD serves, and runs the peering sequence on primaries:
+  GetInfo (MPGQuery → MPGNotify), choose the authoritative log
+  (find_best_info), GetLog (MPGLogReq), pull objects the primary
+  itself is missing (MPGPull), push each peer's missing objects
+  (MPGPush), then activate (MPGActivate carrying the log suffix) —
+  the Initial→GetInfo→GetLog→GetMissing→Active walk of
+  PeeringState.cc collapsed to one deterministic worker pass.
+- I/O: client MOSDOp on the primary appends a pg_log entry and
+  applies ONE transaction locally carrying data + log entry + info,
+  then fans the same transaction out as MOSDRepOp (sub_op_modify:
+  data and log ride one atomic apply).  Reads serve locally.
+- Persistence: log entries and pg info live in the PG's collection
+  (entries as ``_log/`` objects, info as an xattr on ``_pgmeta_``),
+  so a restarted OSD reloads its PGs from the store and rejoins with
+  honest history (load_pgs).
+- Failure detection: a tick thread pings peers (MOSDPing role) and
+  files mon failure reports after the grace window; the monitor's
+  distinct-reporter threshold marks OSDs down, the epoch bumps, and
+  primaries re-peer (OSD.cc:5235 handle_osd_ping / :5889
+  send_failures).
+
+Replicated pools run fully through this daemon.  Erasure pools keep
+the dedicated shard data plane (store/remote.py) — wiring ECStore
+under PG peering is tracked in docs/PARITY.md.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from ..common.encoding import Decoder, Encoder
+from ..msg import (
+    Message,
+    MessageError,
+    Messenger,
+    MOSDOp,
+    MOSDOpReply,
+    MOSDRepOp,
+    MOSDRepOpReply,
+    MPGActivate,
+    MPGLogReply,
+    MPGLogReq,
+    MPGNotify,
+    MPGPull,
+    MPGPush,
+    MPGPushReply,
+    MPGQuery,
+    MPing,
+)
+from ..msg.message import (
+    OSD_OP_DELETE,
+    OSD_OP_READ,
+    OSD_OP_STAT,
+    OSD_OP_WRITE,
+    OSD_OP_WRITEFULL,
+)
+from ..msg.messenger import Connection, Dispatcher
+from ..mon.monitor import MonClient
+from ..store.objectstore import MemStore, ObjectStore, StoreError, Transaction
+from .failure import HeartbeatTracker
+from .pg_log import (
+    DELETE,
+    EV_ZERO,
+    MODIFY,
+    LogEntry,
+    PGInfo,
+    PGLog,
+    find_best_info,
+    needs_backfill,
+)
+
+PG_META = "_pgmeta_"
+LOG_PREFIX = "_log/"
+OBJ_PREFIX = "o_"
+INFO_ATTR = "pginfo"
+
+
+def _log_oid(version: tuple[int, int]) -> str:
+    return f"{LOG_PREFIX}{version[0]:010d}.{version[1]:020d}"
+
+
+def _encode_entry(entry: LogEntry) -> bytes:
+    e = Encoder()
+    entry.encode(e)
+    return e.getvalue()
+
+
+def _decode_entry(blob: bytes) -> LogEntry:
+    return LogEntry.decode(Decoder(blob))
+
+
+def _encode_info(info: PGInfo) -> bytes:
+    e = Encoder()
+    info.encode(e)
+    return e.getvalue()
+
+
+def _decode_info(blob: bytes) -> PGInfo:
+    return PGInfo.decode(Decoder(blob))
+
+
+class PG:
+    """One placement group's local state (PG/PeeringState role)."""
+
+    def __init__(self, pgid: str, pool_id: int):
+        self.pgid = pgid
+        self.pool_id = pool_id
+        self.cid = f"pg_{pgid}"
+        self.log = PGLog()
+        self.info = PGInfo(pgid=pgid)
+        self.state = "initial"  # initial|peering|active|replica|stray
+        self.acting: list[int] = []
+        self.primary: int = -1
+        self.seq = 0  # op counter feeding eversions
+
+
+class OSD(Dispatcher):
+    def __init__(
+        self,
+        whoami: int,
+        store: ObjectStore | None = None,
+        tick_interval: float = 0.5,
+        heartbeat_grace: float = 2.0,
+    ):
+        self.whoami = whoami
+        self.store = store or MemStore()
+        self.messenger = Messenger(f"osd.{whoami}")
+        self.messenger.add_dispatcher(self)
+        self.monc = MonClient(
+            self.messenger, on_map=self._on_map, whoami=whoami
+        )
+        self.pgs: dict[str, PG] = {}
+        self._pg_lock = threading.RLock()
+        self._workq: queue.Queue = queue.Queue()
+        self._worker: threading.Thread | None = None
+        self._ticker: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._conns: dict[int, Connection] = {}
+        self._conn_lock = threading.Lock()
+        self.hb = HeartbeatTracker(whoami, grace=heartbeat_grace)
+        self.tick_interval = tick_interval
+        self.addr: tuple[str, int] | None = None
+        # peers this OSD has filed failure reports for (to withdraw
+        # with failed_for=-1 when they speak again — send_still_alive)
+        self._reported: set[int] = set()
+        # last seen up/down per peer, to reset heartbeat stamps on a
+        # down→up transition (a stale stamp would re-report instantly)
+        self._last_up: dict[int, bool] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def boot(self, mon_host: str, mon_port: int) -> None:
+        """bind → load PGs from disk → mon session → announce
+        (OSD::init + start_boot)."""
+        self.addr = self.messenger.bind()
+        self._load_pgs()
+        self._worker = threading.Thread(
+            target=self._work_loop, name=f"osd.{self.whoami}.wq",
+            daemon=True,
+        )
+        self._worker.start()
+        self.monc.connect(mon_host, mon_port)
+        self.monc.boot(self.whoami, addr=f"{self.addr[0]}:{self.addr[1]}")
+        self._ticker = threading.Thread(
+            target=self._tick_loop, name=f"osd.{self.whoami}.tick",
+            daemon=True,
+        )
+        self._ticker.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._workq.put(None)
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+        self.messenger.shutdown()
+
+    # -- map / PG walk -----------------------------------------------------
+    def _on_map(self, epoch: int) -> None:
+        self._workq.put(("map", epoch))
+
+    def _peer_conn(self, osd: int) -> Connection:
+        with self._conn_lock:
+            conn = self._conns.get(osd)
+            if conn is not None and not conn._closed:
+                return conn
+        osdmap = self.monc.osdmap
+        addr = osdmap.osd_addrs.get(osd, "")
+        host, _, port = addr.partition(":")
+        if not port:
+            # peer already marked down (mark_down drops the addr): the
+            # caller treats it like any unreachable peer
+            raise MessageError(f"osd.{osd} has no address")
+        conn = self.messenger.connect(host, int(port))
+        with self._conn_lock:
+            self._conns[osd] = conn
+        return conn
+
+    def _load_pgs(self) -> None:
+        """Rebuild PG state from the store (OSD::load_pgs)."""
+        for cid in self.store.list_collections():
+            if not cid.startswith("pg_"):
+                continue
+            pgid = cid[3:]
+            pool_id = int(pgid.split(".")[0])
+            pg = PG(pgid, pool_id)
+            try:
+                pg.info = _decode_info(
+                    self.store.getattr(cid, PG_META, INFO_ATTR)
+                )
+            except StoreError:
+                continue
+            entries = sorted(
+                o for o in self.store.list_objects(cid)
+                if o.startswith(LOG_PREFIX)
+            )
+            for oid in entries:
+                pg.log.append(_decode_entry(self.store.read(cid, oid)))
+            if pg.log.entries:
+                pg.log.log_tail = pg.log.entries[0].prior_version
+            pg.seq = pg.info.last_update[1]
+            self.pgs[pgid] = pg
+
+    def _walk_pgs(self, epoch: int) -> None:
+        osdmap = self.monc.osdmap
+        if osdmap is None:
+            return
+        # a peer that came back up gets a fresh heartbeat slate
+        for osd in range(osdmap.max_osd):
+            up = osdmap.is_up(osd)
+            if up and not self._last_up.get(osd, False):
+                self.hb.remove_peer(osd)
+                self._reported.discard(osd)
+            self._last_up[osd] = up
+        for pool_id, pool in osdmap.pools.items():
+            if not pool.can_shift_osds():
+                continue  # EC pools use the shard data plane
+            for ps in range(pool.pg_num):
+                up, _upp, acting, primary = osdmap.pg_to_up_acting_osds(
+                    pool_id, ps
+                )
+                pgid = f"{pool_id}.{ps}"
+                if self.whoami not in acting:
+                    pg = self.pgs.get(pgid)
+                    if pg is not None:
+                        pg.state = "stray"
+                    continue
+                pg = self._get_or_create_pg(pgid)
+                with self._pg_lock:
+                    pg.acting = acting
+                    pg.primary = primary
+                if primary == self.whoami:
+                    self._peer(pg, epoch)
+                else:
+                    pg.state = "replica"
+
+    def _ensure_coll(self, pg: PG) -> None:
+        try:
+            self.store.queue_transaction(
+                Transaction().create_collection(pg.cid)
+            )
+        except StoreError:
+            pass
+
+    # -- peering (primary) -------------------------------------------------
+    def _peer(self, pg: PG, epoch: int) -> None:
+        """GetInfo → GetLog → GetMissing → Active in one worker pass."""
+        pg.state = "peering"
+        peers = [o for o in pg.acting if o != self.whoami]
+        infos: dict[int, PGInfo] = {self.whoami: pg.info}
+        reachable: list[int] = []
+        for osd in peers:
+            try:
+                reply = self._peer_conn(osd).call(
+                    MPGQuery(pgid=pg.pgid, epoch=epoch)
+                )
+            except (MessageError, OSError):
+                continue
+            if isinstance(reply, MPGNotify) and reply.info_blob:
+                infos[osd] = _decode_info(reply.info_blob)
+            elif isinstance(reply, MPGNotify):
+                infos[osd] = PGInfo(pgid=pg.pgid)
+            reachable.append(osd)
+
+        best = find_best_info(infos)
+        if best is not None and best != self.whoami:
+            self._get_log(pg, epoch, best, infos[best])
+
+        # primary consistent: push what each reachable peer misses,
+        # then activate everyone
+        for osd in reachable:
+            peer_info = infos.get(osd, PGInfo(pgid=pg.pgid))
+            self._recover_peer(pg, epoch, osd, peer_info)
+        pg.state = "active"
+        pg.info.last_epoch_started = epoch
+        self._persist_info(pg)
+
+    def _get_log(self, pg: PG, epoch: int, best: int, best_info: PGInfo):
+        """Adopt the authoritative log and pull missing objects."""
+        since = pg.info.last_update
+        if needs_backfill(best_info, pg.info):
+            since = best_info.log_tail
+        try:
+            reply = self._peer_conn(best).call(
+                MPGLogReq(pgid=pg.pgid, epoch=epoch, since=since)
+            )
+        except (MessageError, OSError):
+            return
+        if not isinstance(reply, MPGLogReply):
+            return
+        entries = [_decode_entry(b) for b in reply.entry_blobs]
+        missing: dict[str, LogEntry] = {}
+        for entry in entries:
+            if entry.version <= pg.log.head:
+                continue
+            pg.log.append(entry)
+            self._persist_entry(pg, entry)
+            missing[entry.oid] = entry
+        for oid, entry in missing.items():
+            self._pull_object(pg, epoch, best, oid, entry)
+        pg.info.last_update = pg.log.head
+        pg.seq = max(pg.seq, pg.info.last_update[1])
+        self._persist_info(pg)
+
+    def _pull_object(self, pg, epoch, source, oid, entry) -> None:
+        if entry.op == DELETE:
+            try:
+                self.store.queue_transaction(
+                    Transaction().remove(pg.cid, OBJ_PREFIX + oid)
+                )
+            except StoreError:
+                pass
+            return
+        try:
+            reply = self._peer_conn(source).call(
+                MPGPull(pgid=pg.pgid, epoch=epoch, oid=oid)
+            )
+        except (MessageError, OSError):
+            return
+        if isinstance(reply, MPGPush):
+            self._apply_push(pg, reply)
+
+    def _apply_push(self, pg: PG, push: MPGPush) -> None:
+        txn = Transaction()
+        store_oid = OBJ_PREFIX + push.oid
+        if self.store.exists(pg.cid, store_oid):
+            txn.remove(pg.cid, store_oid)
+        if push.exists:
+            txn.touch(pg.cid, store_oid)
+            if push.data:
+                txn.write(pg.cid, store_oid, 0, push.data)
+            for k, v in push.attrs.items():
+                txn.setattr(pg.cid, store_oid, k, v)
+        if txn.ops:
+            self.store.queue_transaction(txn)
+
+    def _recover_peer(self, pg, epoch, osd, peer_info: PGInfo) -> None:
+        """Push the peer's missing objects, then activate it with the
+        log suffix it lacks."""
+        since = peer_info.last_update
+        backfill = needs_backfill(pg.info, peer_info) or (
+            since > pg.log.head  # divergent future: rewind fully
+        )
+        if backfill:
+            since = pg.log.log_tail
+        try:
+            missing = pg.log.missing_since(since)
+        except AssertionError:
+            missing = pg.log.missing_since(pg.log.log_tail)
+        try:
+            conn = self._peer_conn(osd)
+        except (MessageError, OSError):
+            return
+        for oid, version in missing.items():
+            entry = pg.log.object_op(oid)
+            exists = entry is not None and entry.op != DELETE
+            data = b""
+            if exists:
+                try:
+                    data = self.store.read(pg.cid, OBJ_PREFIX + oid)
+                except StoreError:
+                    exists = False
+            try:
+                conn.call(
+                    MPGPush(
+                        pgid=pg.pgid, epoch=epoch, oid=oid,
+                        exists=exists, data=data,
+                        entry_blob=_encode_entry(entry)
+                        if entry
+                        else b"",
+                    )
+                )
+            except (MessageError, OSError):
+                return
+        suffix = [
+            _encode_entry(e) for e in pg.log.entries_after(
+                max(since, pg.log.log_tail)
+            )
+        ]
+        try:
+            conn.call(
+                MPGActivate(
+                    pgid=pg.pgid, epoch=epoch,
+                    info_blob=_encode_info(pg.info),
+                    entry_blobs=suffix,
+                )
+            )
+        except (MessageError, OSError):
+            pass
+
+    # -- persistence -------------------------------------------------------
+    def _persist_entry(self, pg: PG, entry: LogEntry, txn=None) -> None:
+        own = txn is None
+        txn = txn or Transaction()
+        txn.touch(pg.cid, _log_oid(entry.version))
+        txn.write(pg.cid, _log_oid(entry.version), 0, _encode_entry(entry))
+        if own:
+            self.store.queue_transaction(txn)
+
+    def _persist_info(self, pg: PG, txn=None) -> None:
+        own = txn is None
+        txn = txn or Transaction()
+        # touch is idempotent and MUST be unconditional: the same
+        # transaction ships verbatim to replicas whose store may not
+        # have PG_META yet (a conditional guard against the PRIMARY's
+        # store would abort the whole replicated txn there)
+        txn.touch(pg.cid, PG_META)
+        txn.setattr(pg.cid, PG_META, INFO_ATTR, _encode_info(pg.info))
+        if own:
+            self.store.queue_transaction(txn)
+
+    # -- client op path (primary) ------------------------------------------
+    def _handle_op(self, conn: Connection, msg: MOSDOp) -> None:
+        epoch = self.monc.epoch
+        pg = self.pgs.get(msg.pgid)
+        reply = MOSDOpReply(tid=msg.tid, epoch=epoch)
+        if pg is None or pg.primary != self.whoami or pg.state not in (
+            "active",
+        ):
+            reply.ok = False
+            reply.error = f"not primary for pg {msg.pgid} (-EAGAIN)"
+            conn.send(reply)
+            return
+        store_oid = OBJ_PREFIX + msg.oid
+        try:
+            if msg.op == OSD_OP_READ:
+                reply.data = self.store.read(
+                    pg.cid, store_oid, msg.offset, msg.length
+                )
+            elif msg.op == OSD_OP_STAT:
+                reply.size = self.store.stat(pg.cid, store_oid)
+            else:
+                self._mutate(pg, epoch, msg, store_oid)
+        except StoreError as e:
+            reply.ok = False
+            reply.error = str(e)
+        conn.send(reply)
+
+    def _mutate(self, pg: PG, epoch: int, msg: MOSDOp, store_oid: str):
+        """Append a log entry + apply data in ONE transaction, fan the
+        same transaction to the acting peers (issue_repop)."""
+        pg.seq += 1
+        version = (epoch, pg.seq)
+        op = DELETE if msg.op == OSD_OP_DELETE else MODIFY
+        entry = LogEntry(
+            op=op, oid=msg.oid, version=version,
+            prior_version=pg.info.last_update,
+        )
+        txn = Transaction()
+        if msg.op == OSD_OP_WRITEFULL:
+            if self.store.exists(pg.cid, store_oid):
+                txn.remove(pg.cid, store_oid)
+            txn.touch(pg.cid, store_oid)
+            if msg.data:
+                txn.write(pg.cid, store_oid, 0, msg.data)
+        elif msg.op == OSD_OP_WRITE:
+            txn.write(pg.cid, store_oid, msg.offset, msg.data)
+        elif msg.op == OSD_OP_DELETE:
+            txn.remove(pg.cid, store_oid)
+        self._persist_entry(pg, entry, txn)
+        # advance pg.info inside the txn, but only adopt it in memory
+        # once the local apply succeeded — a failed transaction must
+        # not leave a phantom entry in the in-memory log
+        saved_last = pg.info.last_update
+        pg.info.last_update = version
+        self._persist_info(pg, txn)
+        try:
+            self.store.queue_transaction(txn)
+        except StoreError:
+            pg.info.last_update = saved_last
+            pg.seq -= 1
+            raise
+        pg.log.append(entry)
+        entry_blob = _encode_entry(entry)
+        need_repeer = False
+        for osd in pg.acting:
+            if osd == self.whoami:
+                continue
+            try:
+                ack = self._peer_conn(osd).call(
+                    MOSDRepOp(
+                        pgid=pg.pgid, epoch=epoch, txn=txn,
+                        entry_blob=entry_blob,
+                    )
+                )
+                if isinstance(ack, MOSDRepOpReply) and not ack.ok:
+                    # replica refused (e.g. hasn't activated yet):
+                    # its log is now behind — re-peer to push it
+                    need_repeer = True
+            except (MessageError, OSError):
+                # unreachable replica: the next epoch's peering
+                # recovers it from the log (send_failures handles the
+                # mon side)
+                continue
+        if need_repeer:
+            self._workq.put(("map", epoch))
+
+    # -- replica-side inline handlers --------------------------------------
+    def _handle_rep_op(self, conn: Connection, msg: MOSDRepOp) -> None:
+        pg = self.pgs.get(msg.pgid)
+        reply = MOSDRepOpReply(tid=msg.tid, from_osd=self.whoami)
+        if pg is None:
+            reply.ok = False
+            reply.error = "unknown pg"
+            conn.send(reply)
+            return
+        try:
+            self.store.queue_transaction(msg.txn)
+            entry = _decode_entry(msg.entry_blob)
+            if entry.version > pg.log.head:
+                pg.log.append(entry)
+            pg.info.last_update = pg.log.head
+            pg.seq = max(pg.seq, entry.version[1])
+        except StoreError as e:
+            reply.ok = False
+            reply.error = str(e)
+        conn.send(reply)
+
+    def _handle_query(self, conn: Connection, msg: MPGQuery) -> None:
+        pg = self.pgs.get(msg.pgid)
+        conn.send(
+            MPGNotify(
+                tid=msg.tid, from_osd=self.whoami,
+                info_blob=_encode_info(pg.info) if pg else b"",
+            )
+        )
+
+    def _handle_log_req(self, conn: Connection, msg: MPGLogReq) -> None:
+        pg = self.pgs.get(msg.pgid)
+        reply = MPGLogReply(tid=msg.tid, from_osd=self.whoami)
+        if pg is not None:
+            reply.info_blob = _encode_info(pg.info)
+            since = max(msg.since, pg.log.log_tail)
+            reply.entry_blobs = [
+                _encode_entry(e) for e in pg.log.entries_after(since)
+            ]
+        conn.send(reply)
+
+    def _handle_pull(self, conn: Connection, msg: MPGPull) -> None:
+        pg = self.pgs.get(msg.pgid)
+        push = MPGPush(tid=msg.tid, pgid=msg.pgid, oid=msg.oid)
+        store_oid = OBJ_PREFIX + msg.oid
+        if pg is None or not self.store.exists(pg.cid, store_oid):
+            push.exists = False
+        else:
+            push.data = self.store.read(pg.cid, store_oid)
+        conn.send(push)
+
+    def _get_or_create_pg(self, pgid: str) -> PG:
+        with self._pg_lock:
+            pg = self.pgs.get(pgid)
+            if pg is None:
+                pg = PG(pgid, int(pgid.split(".")[0]))
+                self._ensure_coll(pg)
+                self.pgs[pgid] = pg
+            return pg
+
+    def _handle_push(self, conn: Connection, msg: MPGPush) -> None:
+        pg = self._get_or_create_pg(msg.pgid)
+        self._apply_push(pg, msg)
+        if msg.entry_blob:
+            entry = _decode_entry(msg.entry_blob)
+            if entry.version > pg.log.head:
+                pg.log.append(entry)
+                self._persist_entry(pg, entry)
+        conn.send(MPGPushReply(tid=msg.tid, from_osd=self.whoami))
+
+    def _handle_activate(self, conn: Connection, msg: MPGActivate):
+        pg = self._get_or_create_pg(msg.pgid)
+        for blob in msg.entry_blobs:
+            entry = _decode_entry(blob)
+            if entry.version > pg.log.head:
+                pg.log.append(entry)
+                self._persist_entry(pg, entry)
+        pg.info = _decode_info(msg.info_blob)
+        pg.info.last_update = pg.log.head
+        pg.seq = max(pg.seq, pg.info.last_update[1])
+        pg.state = "replica"
+        self._persist_info(pg)
+        conn.send(MPGPushReply(tid=msg.tid, from_osd=self.whoami))
+
+    # -- dispatch ----------------------------------------------------------
+    def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if isinstance(msg, MOSDOp):
+            # nested RPC needed → worker queue (enqueue_op)
+            self._workq.put(("op", conn, msg))
+            return True
+        if isinstance(msg, MOSDRepOp):
+            self._handle_rep_op(conn, msg)
+            return True
+        if isinstance(msg, MPGQuery):
+            self._handle_query(conn, msg)
+            return True
+        if isinstance(msg, MPGLogReq):
+            self._handle_log_req(conn, msg)
+            return True
+        if isinstance(msg, MPGPull):
+            self._handle_pull(conn, msg)
+            return True
+        if isinstance(msg, MPGPush):
+            self._handle_push(conn, msg)
+            return True
+        if isinstance(msg, MPGActivate):
+            self._handle_activate(conn, msg)
+            return True
+        if isinstance(msg, MPing):
+            if msg.is_reply:
+                self.hb.handle_ping(msg.from_osd, time.monotonic())
+                if msg.from_osd in self._reported:
+                    self._reported.discard(msg.from_osd)
+                    try:
+                        self.monc.report_failure(msg.from_osd, -1.0)
+                    except (MessageError, OSError):
+                        pass
+            else:
+                conn.send(
+                    MPing(
+                        tid=msg.tid, from_osd=self.whoami,
+                        stamp=msg.stamp, is_reply=True,
+                    )
+                )
+            return True
+        return False
+
+    # -- worker / ticker ---------------------------------------------------
+    def _work_loop(self) -> None:
+        while not self._stop.is_set():
+            item = self._workq.get()
+            if item is None:
+                return
+            kind = item[0]
+            try:
+                if kind == "map":
+                    self._walk_pgs(item[1])
+                elif kind == "op":
+                    self._handle_op(item[1], item[2])
+            except Exception:  # noqa: BLE001 — worker must survive
+                import traceback
+
+                traceback.print_exc()
+
+    def _peers_of_interest(self) -> set[int]:
+        peers: set[int] = set()
+        with self._pg_lock:
+            for pg in self.pgs.values():
+                if pg.state in ("active", "replica", "peering"):
+                    peers.update(pg.acting)
+        peers.discard(self.whoami)
+        return peers
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(self.tick_interval):
+            now = time.monotonic()
+            interesting = self._peers_of_interest()
+            # peers that left every acting set (e.g. marked down) stop
+            # being tracked — a stale last-rx stamp would otherwise
+            # keep generating failure reports forever and instantly
+            # re-down a rebooted peer (the reference prunes its
+            # heartbeat_peers on map change too, OSD::maybe_update_heartbeat_peers)
+            for osd in self.hb.peers() - interesting:
+                self.hb.remove_peer(osd)
+            for osd in interesting:
+                if osd not in self.hb.peers():
+                    self.hb.add_peer(osd, now)
+                try:
+                    self._peer_conn(osd).send(
+                        MPing(
+                            tid=self.messenger.new_tid(),
+                            from_osd=self.whoami,
+                            stamp=now,
+                        )
+                    )
+                except (MessageError, OSError, KeyError, ValueError):
+                    pass
+            for osd, silent_for in self.hb.failures(now):
+                try:
+                    self.monc.report_failure(osd, silent_for)
+                    self._reported.add(osd)
+                except (MessageError, OSError):
+                    pass

@@ -160,14 +160,11 @@ class ECStore:
         )
 
     def _exit(self, name: str, ticket: int) -> int:
-        seq = []
-
         def on_exit():
             self.extent_cache.close(name)
-            seq.append(next(self._commit_seq))
+            return next(self._commit_seq)
 
-        self._opq.exit(name, ticket, on_exit=on_exit)
-        return seq[0]
+        return self._opq.exit(name, ticket, on_exit=on_exit)
 
     def write(self, name: str, offset: int, data: bytes) -> int:
         """Partial overwrite with read-modify-write (start_rmw,

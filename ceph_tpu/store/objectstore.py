@@ -88,6 +88,9 @@ class ObjectStore:
     def list_objects(self, cid: str) -> list[str]:
         raise NotImplementedError
 
+    def list_collections(self) -> list[str]:
+        raise NotImplementedError
+
 
 class _TxnState:
     """Shadow state for one transaction: copies only the objects the
@@ -249,6 +252,10 @@ class MemStore(ObjectStore):
     def exists(self, cid, oid) -> bool:
         with self._lock:
             return oid in self._colls.get(cid, {})
+
+    def list_collections(self) -> list[str]:
+        with self._lock:
+            return sorted(self._colls)
 
     def list_objects(self, cid) -> list[str]:
         with self._lock:

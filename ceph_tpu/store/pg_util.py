@@ -53,13 +53,16 @@ class ObjectOpQueue:
                 self._cond.wait()
             return ticket
 
-    def exit(self, name: str, ticket: int, on_exit=None) -> None:
+    def exit(self, name: str, ticket: int, on_exit=None):
+        """Release the ticket; returns on_exit()'s result (run under
+        the queue lock) so callers can hand values out of the critical
+        section without closure plumbing."""
         with self._cond:
             q = self._queues[name]
             assert q[0] == ticket
             q.popleft()
             if not q:
                 del self._queues[name]
-            if on_exit is not None:
-                on_exit()
+            result = on_exit() if on_exit is not None else None
             self._cond.notify_all()
+            return result
