@@ -136,3 +136,65 @@ def crush_hash32_5(a, b, c, d, e):
     d, x, h = _mix_inner(d, x, h)
     y, e, h = _mix_inner(y, e, h)
     return _ret(h, scalar)
+
+
+def ceph_str_hash_rjenkins(name: bytes | str) -> int:
+    """Object-name hash feeding PG placement
+    (src/common/ceph_hash.cc ceph_str_hash_rjenkins — Jenkins lookup2
+    over the name bytes; the default pg_pool_t object_hash)."""
+    if isinstance(name, str):
+        name = name.encode("utf-8")
+    k = name
+    length = len(k)
+    a = 0x9E3779B9
+    b = a
+    c = 0
+    M = 0xFFFFFFFF
+
+    def mix(a, b, c):
+        a = (a - b - c) & M; a ^= c >> 13
+        b = (b - c - a) & M; b ^= (a << 8) & M
+        c = (c - a - b) & M; c ^= b >> 13
+        a = (a - b - c) & M; a ^= c >> 12
+        b = (b - c - a) & M; b ^= (a << 16) & M
+        c = (c - a - b) & M; c ^= b >> 5
+        a = (a - b - c) & M; a ^= c >> 3
+        b = (b - c - a) & M; b ^= (a << 10) & M
+        c = (c - a - b) & M; c ^= b >> 15
+        return a, b, c
+
+    i = 0
+    rem = length
+    while rem >= 12:
+        a = (a + int.from_bytes(k[i : i + 4], "little")) & M
+        b = (b + int.from_bytes(k[i + 4 : i + 8], "little")) & M
+        c = (c + int.from_bytes(k[i + 8 : i + 12], "little")) & M
+        a, b, c = mix(a, b, c)
+        i += 12
+        rem -= 12
+    c = (c + length) & M
+    tail = k[i:]
+    if rem >= 11:
+        c = (c + (tail[10] << 24)) & M
+    if rem >= 10:
+        c = (c + (tail[9] << 16)) & M
+    if rem >= 9:
+        c = (c + (tail[8] << 8)) & M
+    if rem >= 8:
+        b = (b + (tail[7] << 24)) & M
+    if rem >= 7:
+        b = (b + (tail[6] << 16)) & M
+    if rem >= 6:
+        b = (b + (tail[5] << 8)) & M
+    if rem >= 5:
+        b = (b + tail[4]) & M
+    if rem >= 4:
+        a = (a + (tail[3] << 24)) & M
+    if rem >= 3:
+        a = (a + (tail[2] << 16)) & M
+    if rem >= 2:
+        a = (a + (tail[1] << 8)) & M
+    if rem >= 1:
+        a = (a + tail[0]) & M
+    _a, _b, c = mix(a, b, c)
+    return c

@@ -360,6 +360,10 @@ OSD_OP_WRITE = 1
 OSD_OP_READ = 2
 OSD_OP_DELETE = 3
 OSD_OP_STAT = 4
+OSD_OP_SETXATTR = 5  # oid attr (in .oid/.attr), value in .data
+OSD_OP_GETXATTR = 6
+OSD_OP_LIST = 7  # list this PG's objects (the pgls op)
+OSD_OP_APPEND = 8  # atomic append (offset resolved on the primary)
 
 
 @register_message
@@ -377,19 +381,23 @@ class MOSDOp(Message):
     offset: int = 0
     length: int = 0
     data: bytes = b""
+    attr: str = ""
+    reqid: str = ""  # stable across retries (osd_reqid_t role)
     epoch: int = 0  # client's map epoch (primary checks staleness)
 
     def encode_payload(self, e: Encoder) -> None:
         e.s64(self.pool).string(self.pgid).string(self.oid)
         e.u8(self.op).u64(self.offset).s64(self.length)
-        e.bytes(self.data).u32(self.epoch)
+        e.bytes(self.data).string(self.attr).string(self.reqid)
+        e.u32(self.epoch)
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MOSDOp":
         return cls(
             pool=d.s64(), pgid=d.string(), oid=d.string(),
             op=d.u8(), offset=d.u64(), length=d.s64(),
-            data=d.bytes(), epoch=d.u32(),
+            data=d.bytes(), attr=d.string(), reqid=d.string(),
+            epoch=d.u32(),
         )
 
 
@@ -402,17 +410,20 @@ class MOSDOpReply(Message):
     ok: bool = True
     error: str = ""
     data: bytes = b""
+    names: list = field(default_factory=list)
     size: int = 0
     epoch: int = 0  # primary's epoch (client refreshes when ahead)
 
     def encode_payload(self, e: Encoder) -> None:
         e.bool(self.ok).string(self.error).bytes(self.data)
+        e.list(self.names, lambda e2, n: e2.string(n))
         e.u64(self.size).u32(self.epoch)
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MOSDOpReply":
         return cls(
             ok=d.bool(), error=d.string(), data=d.bytes(),
+            names=d.list(lambda d2: d2.string()),
             size=d.u64(), epoch=d.u32(),
         )
 
@@ -479,18 +490,25 @@ class MPGQuery(Message):
 @register_message
 @dataclass
 class MPGNotify(Message):
-    """Peer → primary: pg_info (MNotifyRec role)."""
+    """Peer → primary: pg_info + recent log suffix (MNotifyRec role;
+    the log rides along so the primary can locate the divergence
+    point, the proc_replica_log input)."""
 
     TYPE = 17
     from_osd: int = 0
     info_blob: bytes = b""  # encoded PGInfo ('' = pg unknown here)
+    entry_blobs: list = field(default_factory=list)
 
     def encode_payload(self, e: Encoder) -> None:
         e.s32(self.from_osd).bytes(self.info_blob)
+        e.list(self.entry_blobs, lambda e2, b: e2.bytes(b))
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MPGNotify":
-        return cls(from_osd=d.s32(), info_blob=d.bytes())
+        return cls(
+            from_osd=d.s32(), info_blob=d.bytes(),
+            entry_blobs=d.list(lambda d2: d2.bytes()),
+        )
 
 
 @register_message
@@ -591,23 +609,28 @@ class MPGPushReply(Message):
 @register_message
 @dataclass
 class MPGActivate(Message):
-    """Primary → peer: peering finished — adopt the authoritative log
-    suffix and go active (the MOSDPGLog activation message)."""
+    """Primary → peer: peering finished — rewind divergent entries
+    past ``rewind_to``, adopt the authoritative log suffix, go active
+    (the MOSDPGLog activation message with the merge_log divergence
+    point)."""
 
     TYPE = 22
     pgid: str = ""
     epoch: int = 0
     info_blob: bytes = b""  # primary's (authoritative) info
+    rewind_to: tuple = (0, 0)  # newest version shared with the auth log
     entry_blobs: list = field(default_factory=list)
 
     def encode_payload(self, e: Encoder) -> None:
         e.string(self.pgid).u32(self.epoch).bytes(self.info_blob)
+        e.u32(self.rewind_to[0]).u64(self.rewind_to[1])
         e.list(self.entry_blobs, lambda e2, b: e2.bytes(b))
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MPGActivate":
         return cls(
             pgid=d.string(), epoch=d.u32(), info_blob=d.bytes(),
+            rewind_to=(d.u32(), d.u64()),
             entry_blobs=d.list(lambda d2: d2.bytes()),
         )
 

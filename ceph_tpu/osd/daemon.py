@@ -64,8 +64,12 @@ from ..msg import (
     MPing,
 )
 from ..msg.message import (
+    OSD_OP_APPEND,
     OSD_OP_DELETE,
+    OSD_OP_GETXATTR,
+    OSD_OP_LIST,
     OSD_OP_READ,
+    OSD_OP_SETXATTR,
     OSD_OP_STAT,
     OSD_OP_WRITE,
     OSD_OP_WRITEFULL,
@@ -128,6 +132,12 @@ class PG:
         self.acting: list[int] = []
         self.primary: int = -1
         self.seq = 0  # op counter feeding eversions
+        # epoch of the last MPGActivate applied here (0 = never in
+        # this incarnation); replicas refuse rep-ops until activated
+        self.activated_epoch = 0
+        # the (acting, primary) interval last peered, so unrelated
+        # epoch bumps don't trigger a re-peering RPC storm
+        self.peered_interval: tuple | None = None
 
 
 class OSD(Dispatcher):
@@ -155,6 +165,7 @@ class OSD(Dispatcher):
         self._conn_lock = threading.Lock()
         self.hb = HeartbeatTracker(whoami, grace=heartbeat_grace)
         self.tick_interval = tick_interval
+        self.log_keep = 128  # pg_log length bound (osd_min_pg_log_entries role)
         self.addr: tuple[str, int] | None = None
         # peers this OSD has filed failure reports for (to withdraw
         # with failed_for=-1 when they speak again — send_still_alive)
@@ -228,10 +239,9 @@ class OSD(Dispatcher):
                 o for o in self.store.list_objects(cid)
                 if o.startswith(LOG_PREFIX)
             )
+            pg.log.log_tail = pg.info.log_tail
             for oid in entries:
                 pg.log.append(_decode_entry(self.store.read(cid, oid)))
-            if pg.log.entries:
-                pg.log.log_tail = pg.log.entries[0].prior_version
             pg.seq = pg.info.last_update[1]
             self.pgs[pgid] = pg
 
@@ -260,13 +270,25 @@ class OSD(Dispatcher):
                         pg.state = "stray"
                     continue
                 pg = self._get_or_create_pg(pgid)
+                interval = (tuple(acting), primary)
                 with self._pg_lock:
+                    changed = pg.peered_interval != interval
                     pg.acting = acting
                     pg.primary = primary
                 if primary == self.whoami:
-                    self._peer(pg, epoch)
+                    # re-peer only on interval change (the reference's
+                    # new-interval test) — an unrelated epoch bump must
+                    # not trigger a cluster-wide RPC storm
+                    if changed or pg.state != "active":
+                        self._peer(pg, epoch)
+                        pg.peered_interval = interval
                 else:
+                    if changed:
+                        # new interval: wait for the primary's
+                        # activation before accepting rep-ops
+                        pg.activated_epoch = 0
                     pg.state = "replica"
+                    pg.peered_interval = interval
 
     def _ensure_coll(self, pg: PG) -> None:
         try:
@@ -282,6 +304,7 @@ class OSD(Dispatcher):
         pg.state = "peering"
         peers = [o for o in pg.acting if o != self.whoami]
         infos: dict[int, PGInfo] = {self.whoami: pg.info}
+        peer_logs: dict[int, list[LogEntry]] = {}
         reachable: list[int] = []
         for osd in peers:
             try:
@@ -292,22 +315,52 @@ class OSD(Dispatcher):
                 continue
             if isinstance(reply, MPGNotify) and reply.info_blob:
                 infos[osd] = _decode_info(reply.info_blob)
+                peer_logs[osd] = [
+                    _decode_entry(b) for b in reply.entry_blobs
+                ]
             elif isinstance(reply, MPGNotify):
                 infos[osd] = PGInfo(pgid=pg.pgid)
+                peer_logs[osd] = []
             reachable.append(osd)
 
         best = find_best_info(infos)
         if best is not None and best != self.whoami:
             self._get_log(pg, epoch, best, infos[best])
 
-        # primary consistent: push what each reachable peer misses,
-        # then activate everyone
+        # primary consistent: rewind+push what each reachable peer
+        # misses, then activate everyone
         for osd in reachable:
             peer_info = infos.get(osd, PGInfo(pgid=pg.pgid))
-            self._recover_peer(pg, epoch, osd, peer_info)
+            rewind = self._divergence_point(
+                pg, peer_info, peer_logs.get(osd, [])
+            )
+            self._recover_peer(pg, epoch, osd, peer_info, rewind)
         pg.state = "active"
+        pg.activated_epoch = epoch
         pg.info.last_epoch_started = epoch
         self._persist_info(pg)
+
+    def _divergence_point(
+        self, pg: PG, peer_info: PGInfo, peer_entries: list[LogEntry]
+    ) -> tuple[int, int]:
+        """Newest version the peer's log shares with the authoritative
+        log (proc_replica_log): the peer must rewind everything after
+        it.  With no divergence this is the peer's last_update."""
+        if not peer_entries:
+            return min(peer_info.last_update, pg.log.head)
+        own = {
+            e.version: (e.oid, e.op) for e in pg.log.entries
+        }
+        common = pg.log.log_tail
+        for entry in sorted(peer_entries, key=lambda e: e.version):
+            if own.get(entry.version) == (entry.oid, entry.op):
+                common = max(common, entry.version)
+            elif entry.version > pg.log.head or (
+                entry.version in own
+                and own[entry.version] != (entry.oid, entry.op)
+            ) or entry.version > common:
+                break  # first divergent entry ends the shared prefix
+        return common
 
     def _get_log(self, pg: PG, epoch: int, best: int, best_info: PGInfo):
         """Adopt the authoritative log and pull missing objects."""
@@ -368,59 +421,64 @@ class OSD(Dispatcher):
         if txn.ops:
             self.store.queue_transaction(txn)
 
-    def _recover_peer(self, pg, epoch, osd, peer_info: PGInfo) -> None:
-        """Push the peer's missing objects, then activate it with the
-        log suffix it lacks."""
-        since = peer_info.last_update
-        backfill = needs_backfill(pg.info, peer_info) or (
-            since > pg.log.head  # divergent future: rewind fully
-        )
-        if backfill:
+    def _recover_peer(
+        self, pg, epoch, osd, peer_info: PGInfo,
+        rewind: tuple[int, int],
+    ) -> None:
+        """Push the peer's missing objects (since its divergence
+        point), then activate it: the peer rewinds past ``rewind``
+        and adopts the authoritative suffix."""
+        since = rewind
+        if needs_backfill(pg.info, peer_info) or since < pg.log.log_tail:
             since = pg.log.log_tail
-        try:
-            missing = pg.log.missing_since(since)
-        except AssertionError:
-            missing = pg.log.missing_since(pg.log.log_tail)
+        missing = pg.log.missing_since(since)
         try:
             conn = self._peer_conn(osd)
         except (MessageError, OSError):
             return
         for oid, version in missing.items():
-            entry = pg.log.object_op(oid)
-            exists = entry is not None and entry.op != DELETE
-            data = b""
-            if exists:
-                try:
-                    data = self.store.read(pg.cid, OBJ_PREFIX + oid)
-                except StoreError:
-                    exists = False
             try:
-                conn.call(
-                    MPGPush(
-                        pgid=pg.pgid, epoch=epoch, oid=oid,
-                        exists=exists, data=data,
-                        entry_blob=_encode_entry(entry)
-                        if entry
-                        else b"",
-                    )
-                )
+                conn.call(self._push_for(pg, epoch, oid))
             except (MessageError, OSError):
                 return
         suffix = [
-            _encode_entry(e) for e in pg.log.entries_after(
-                max(since, pg.log.log_tail)
-            )
+            _encode_entry(e) for e in pg.log.entries_after(since)
         ]
         try:
-            conn.call(
+            # fire-and-forget: blocking here can cross-deadlock two
+            # primaries whose workers are each peering a PG the other
+            # replicates (activation acks are async in the reference
+            # too); an unactivated replica simply NAKs rep-ops until
+            # its queued activation lands
+            conn.send(
                 MPGActivate(
+                    tid=self.messenger.new_tid(),
                     pgid=pg.pgid, epoch=epoch,
                     info_blob=_encode_info(pg.info),
+                    rewind_to=since,
                     entry_blobs=suffix,
                 )
             )
         except (MessageError, OSError):
             pass
+
+    def _push_for(self, pg: PG, epoch: int, oid: str) -> MPGPush:
+        """One object's recovery push, attrs included (prep_push)."""
+        entry = pg.log.object_op(oid)
+        exists = entry is None or entry.op != DELETE
+        data = b""
+        attrs: dict[str, bytes] = {}
+        if exists:
+            try:
+                data = self.store.read(pg.cid, OBJ_PREFIX + oid)
+                attrs = self.store.list_attrs(pg.cid, OBJ_PREFIX + oid)
+            except StoreError:
+                exists = False
+        return MPGPush(
+            pgid=pg.pgid, epoch=epoch, oid=oid,
+            exists=exists, data=data, attrs=attrs,
+            entry_blob=_encode_entry(entry) if entry else b"",
+        )
 
     # -- persistence -------------------------------------------------------
     def _persist_entry(self, pg: PG, entry: LogEntry, txn=None) -> None:
@@ -463,6 +521,16 @@ class OSD(Dispatcher):
                 )
             elif msg.op == OSD_OP_STAT:
                 reply.size = self.store.stat(pg.cid, store_oid)
+            elif msg.op == OSD_OP_GETXATTR:
+                reply.data = self.store.getattr(
+                    pg.cid, store_oid, "u_" + msg.attr
+                )
+            elif msg.op == OSD_OP_LIST:
+                reply.names = sorted(
+                    o[len(OBJ_PREFIX):]
+                    for o in self.store.list_objects(pg.cid)
+                    if o.startswith(OBJ_PREFIX)
+                )
             else:
                 self._mutate(pg, epoch, msg, store_oid)
         except StoreError as e:
@@ -472,23 +540,53 @@ class OSD(Dispatcher):
 
     def _mutate(self, pg: PG, epoch: int, msg: MOSDOp, store_oid: str):
         """Append a log entry + apply data in ONE transaction, fan the
-        same transaction to the acting peers (issue_repop)."""
+        same transaction to the acting peers (issue_repop).  Raises
+        StoreError to surface op errors; replica failures surface as
+        -EAGAIN so the client retries after the interval changes."""
+        if msg.reqid and any(
+            e.reqid == msg.reqid for e in pg.log.entries
+        ):
+            return  # retried op already applied (osd_reqid_t dedup)
+        existed = self.store.exists(pg.cid, store_oid)
+        if msg.op == OSD_OP_DELETE and not existed:
+            last = pg.log.object_op(msg.oid)
+            if last is not None and last.op == DELETE:
+                return  # idempotent delete (retried op)
+            raise StoreError(f"no object {msg.oid} (-ENOENT)")
         pg.seq += 1
         version = (epoch, pg.seq)
         op = DELETE if msg.op == OSD_OP_DELETE else MODIFY
+        prior = pg.log.object_op(msg.oid)
         entry = LogEntry(
-            op=op, oid=msg.oid, version=version,
-            prior_version=pg.info.last_update,
+            op=op, oid=msg.oid, version=version, reqid=msg.reqid,
+            # the OBJECT's previous version: EV_ZERO means it did not
+            # exist before this op (drives divergent rollback); if the
+            # log no longer says, (1, 0) marks "existed, version
+            # unknown" — still nonzero, still rolls back via re-pull
+            prior_version=(
+                prior.version if prior is not None
+                else ((1, 0) if existed else EV_ZERO)
+            ),
         )
         txn = Transaction()
         if msg.op == OSD_OP_WRITEFULL:
-            if self.store.exists(pg.cid, store_oid):
+            if existed:
                 txn.remove(pg.cid, store_oid)
             txn.touch(pg.cid, store_oid)
             if msg.data:
                 txn.write(pg.cid, store_oid, 0, msg.data)
         elif msg.op == OSD_OP_WRITE:
             txn.write(pg.cid, store_oid, msg.offset, msg.data)
+        elif msg.op == OSD_OP_APPEND:
+            # offset resolved HERE, inside the primary's per-PG op
+            # stream — that is what makes append atomic
+            size = self.store.stat(pg.cid, store_oid) if existed else 0
+            if not existed:
+                txn.touch(pg.cid, store_oid)
+            txn.write(pg.cid, store_oid, size, msg.data)
+        elif msg.op == OSD_OP_SETXATTR:
+            txn.touch(pg.cid, store_oid)
+            txn.setattr(pg.cid, store_oid, "u_" + msg.attr, msg.data)
         elif msg.op == OSD_OP_DELETE:
             txn.remove(pg.cid, store_oid)
         self._persist_entry(pg, entry, txn)
@@ -506,7 +604,7 @@ class OSD(Dispatcher):
             raise
         pg.log.append(entry)
         entry_blob = _encode_entry(entry)
-        need_repeer = False
+        failed: list[int] = []
         for osd in pg.acting:
             if osd == self.whoami:
                 continue
@@ -518,24 +616,54 @@ class OSD(Dispatcher):
                     )
                 )
                 if isinstance(ack, MOSDRepOpReply) and not ack.ok:
-                    # replica refused (e.g. hasn't activated yet):
-                    # its log is now behind — re-peer to push it
-                    need_repeer = True
+                    failed.append(osd)
             except (MessageError, OSError):
-                # unreachable replica: the next epoch's peering
-                # recovers it from the log (send_failures handles the
-                # mon side)
-                continue
-        if need_repeer:
+                failed.append(osd)
+        live_failures = [
+            osd for osd in failed if self.monc.osdmap.is_up(osd)
+        ]
+        if live_failures:
+            # an up replica missed the write: re-peer to push it, and
+            # make the client retry rather than acking a write that is
+            # not on the full acting set (the reference blocks the op
+            # until every acting replica commits).  Clearing the
+            # peered interval defeats the unchanged-interval skip so
+            # the walk really re-peers (a lost fire-and-forget
+            # activation would otherwise NAK forever).
+            pg.peered_interval = None
             self._workq.put(("map", epoch))
+            raise StoreError(
+                f"replicas {live_failures} missed the write (-EAGAIN)"
+            )
+        self._maybe_trim(pg)
+
+    def _maybe_trim(self, pg: PG) -> None:
+        """Bound the pg log (PGLog::trim), removing the trimmed
+        entries' persisted objects and recording the new tail."""
+        if len(pg.log.entries) <= self.log_keep:
+            return
+        cut = pg.log.entries[: len(pg.log.entries) - self.log_keep]
+        pg.log.trim(self.log_keep)
+        pg.info.log_tail = pg.log.log_tail
+        txn = Transaction()
+        for entry in cut:
+            txn.remove(pg.cid, _log_oid(entry.version))
+        self._persist_info(pg, txn)
+        try:
+            self.store.queue_transaction(txn)
+        except StoreError:
+            pass
 
     # -- replica-side inline handlers --------------------------------------
     def _handle_rep_op(self, conn: Connection, msg: MOSDRepOp) -> None:
         pg = self.pgs.get(msg.pgid)
         reply = MOSDRepOpReply(tid=msg.tid, from_osd=self.whoami)
-        if pg is None:
+        if pg is None or pg.activated_epoch == 0:
+            # an unactivated replica must not splice mid-stream
+            # entries into an empty log (its hole-filled log could
+            # later win find_best_info's tie-break)
             reply.ok = False
-            reply.error = "unknown pg"
+            reply.error = "pg not activated (-EAGAIN)"
             conn.send(reply)
             return
         try:
@@ -552,12 +680,15 @@ class OSD(Dispatcher):
 
     def _handle_query(self, conn: Connection, msg: MPGQuery) -> None:
         pg = self.pgs.get(msg.pgid)
-        conn.send(
-            MPGNotify(
-                tid=msg.tid, from_osd=self.whoami,
-                info_blob=_encode_info(pg.info) if pg else b"",
-            )
-        )
+        notify = MPGNotify(tid=msg.tid, from_osd=self.whoami)
+        if pg is not None:
+            notify.info_blob = _encode_info(pg.info)
+            # recent suffix so the primary can locate the divergence
+            # point (proc_replica_log input)
+            notify.entry_blobs = [
+                _encode_entry(e) for e in pg.log.entries[-64:]
+            ]
+        conn.send(notify)
 
     def _handle_log_req(self, conn: Connection, msg: MPGLogReq) -> None:
         pg = self.pgs.get(msg.pgid)
@@ -572,12 +703,15 @@ class OSD(Dispatcher):
 
     def _handle_pull(self, conn: Connection, msg: MPGPull) -> None:
         pg = self.pgs.get(msg.pgid)
-        push = MPGPush(tid=msg.tid, pgid=msg.pgid, oid=msg.oid)
-        store_oid = OBJ_PREFIX + msg.oid
-        if pg is None or not self.store.exists(pg.cid, store_oid):
-            push.exists = False
+        if pg is None:
+            push = MPGPush(
+                tid=msg.tid, pgid=msg.pgid, oid=msg.oid, exists=False
+            )
         else:
-            push.data = self.store.read(pg.cid, store_oid)
+            push = self._push_for(pg, msg.epoch, msg.oid)
+            push.tid = msg.tid
+            if not self.store.exists(pg.cid, OBJ_PREFIX + msg.oid):
+                push.exists = False
         conn.send(push)
 
     def _get_or_create_pg(self, pgid: str) -> PG:
@@ -599,8 +733,38 @@ class OSD(Dispatcher):
                 self._persist_entry(pg, entry)
         conn.send(MPGPushReply(tid=msg.tid, from_osd=self.whoami))
 
-    def _handle_activate(self, conn: Connection, msg: MPGActivate):
+    def _apply_activate(self, conn: Connection, msg: MPGActivate):
+        """Worker-side activation: rewind divergent entries (removing
+        their objects, re-pulling survivors from the primary over the
+        SAME connection), adopt the authoritative suffix, go active
+        (PGLog::rewind_divergent_log + merge_log).  Runs on the worker
+        because the re-pulls are nested RPC."""
         pg = self._get_or_create_pg(msg.pgid)
+        divergent = pg.log.truncate_after(msg.rewind_to)
+        repull: set[str] = set()
+        for entry in divergent:  # newest first
+            txn = Transaction()
+            store_oid = OBJ_PREFIX + entry.oid
+            if self.store.exists(pg.cid, store_oid):
+                txn.remove(pg.cid, store_oid)
+            txn.remove(pg.cid, _log_oid(entry.version))
+            try:
+                self.store.queue_transaction(txn)
+            except StoreError:
+                pass
+            if entry.prior_version != EV_ZERO:
+                # the object existed before the divergent op: its
+                # authoritative state must come back from the primary
+                repull.add(entry.oid)
+        for oid in sorted(repull):
+            try:
+                reply = conn.call(
+                    MPGPull(pgid=pg.pgid, epoch=msg.epoch, oid=oid)
+                )
+            except (MessageError, OSError):
+                continue
+            if isinstance(reply, MPGPush):
+                self._apply_push(pg, reply)
         for blob in msg.entry_blobs:
             entry = _decode_entry(blob)
             if entry.version > pg.log.head:
@@ -610,6 +774,7 @@ class OSD(Dispatcher):
         pg.info.last_update = pg.log.head
         pg.seq = max(pg.seq, pg.info.last_update[1])
         pg.state = "replica"
+        pg.activated_epoch = msg.epoch
         self._persist_info(pg)
         conn.send(MPGPushReply(tid=msg.tid, from_osd=self.whoami))
 
@@ -635,7 +800,8 @@ class OSD(Dispatcher):
             self._handle_push(conn, msg)
             return True
         if isinstance(msg, MPGActivate):
-            self._handle_activate(conn, msg)
+            # rollback may re-pull objects (nested RPC) → worker queue
+            self._workq.put(("activate", conn, msg))
             return True
         if isinstance(msg, MPing):
             if msg.is_reply:
@@ -668,6 +834,8 @@ class OSD(Dispatcher):
                     self._walk_pgs(item[1])
                 elif kind == "op":
                     self._handle_op(item[1], item[2])
+                elif kind == "activate":
+                    self._apply_activate(item[1], item[2])
             except Exception:  # noqa: BLE001 — worker must survive
                 import traceback
 

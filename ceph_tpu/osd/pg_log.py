@@ -33,11 +33,15 @@ class LogEntry:
     oid: str
     version: tuple[int, int]
     prior_version: tuple[int, int] = EV_ZERO
+    # client request id (osd_reqid_t role): lets the primary detect a
+    # retried op and ack it without re-applying (append idempotency)
+    reqid: str = ""
 
     def encode(self, e: Encoder) -> None:
         e.u8(self.op).string(self.oid)
         e.u32(self.version[0]).u64(self.version[1])
         e.u32(self.prior_version[0]).u64(self.prior_version[1])
+        e.string(self.reqid)
 
     @classmethod
     def decode(cls, d: Decoder) -> "LogEntry":
@@ -46,6 +50,7 @@ class LogEntry:
             oid=d.string(),
             version=(d.u32(), d.u64()),
             prior_version=(d.u32(), d.u64()),
+            reqid=d.string(),
         )
 
 
@@ -111,12 +116,18 @@ class PGLog:
         supersede older modifies of the same object."""
         missing: dict[str, tuple[int, int]] = {}
         for entry in self.entries_after(version):
-            if entry.op == DELETE:
-                missing.pop(entry.oid, None)
-                missing[entry.oid] = entry.version
-            else:
-                missing[entry.oid] = entry.version
+            # newest op wins — DELETEs are pushed too (the peer must
+            # apply the removal)
+            missing[entry.oid] = entry.version
         return missing
+
+    def truncate_after(self, version: tuple[int, int]) -> list[LogEntry]:
+        """Drop entries strictly newer than ``version`` (the divergent
+        rewind of PGLog::rewind_divergent_log); returns them newest
+        first, the order rollback wants."""
+        removed = [e for e in self.entries if e.version > version]
+        self.entries = [e for e in self.entries if e.version <= version]
+        return list(reversed(removed))
 
     def object_op(self, oid: str) -> LogEntry | None:
         """Newest entry for an object, if still in the log."""

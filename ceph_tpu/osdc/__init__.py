@@ -1,0 +1,5 @@
+"""osdc — the client op engine (src/osdc/)."""
+
+from .objecter import Objecter, ObjecterError, object_to_pg
+
+__all__ = ["Objecter", "ObjecterError", "object_to_pg"]

@@ -1,0 +1,134 @@
+"""Objecter — client op targeting and retry (src/osdc/Objecter.cc).
+
+``_calc_target``: object name → ps (ceph_str_hash_rjenkins, the
+pg_pool_t object_hash) → stable pg seed → up/acting/primary via the
+client's OSDMap — exactly OSDMap::object_locator_to_pg +
+pg_to_up_acting_osds (Objecter.cc:_calc_target).
+
+``op_submit`` sends the MOSDOp to the computed primary and retries
+when the target is wrong or gone: a -EAGAIN reply (peering, stale
+primary), a connection reset, or a map epoch advance all re-target
+and resend, the reference's resend-on-map-change contract
+(Objecter::_scan_requests / op_submit retry loop).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import time
+
+from ..crush.hashing import ceph_str_hash_rjenkins
+from ..msg import Messenger, MessageError, MOSDOp, MOSDOpReply
+from ..msg.messenger import Connection
+
+
+class ObjecterError(Exception):
+    pass
+
+
+class ObjectNotFound(ObjecterError):
+    pass
+
+
+def object_to_pg(pool, oid: str) -> str:
+    """pgid string for an object (object_locator_to_pg)."""
+    raw_ps = ceph_str_hash_rjenkins(oid)
+    ps = pool.raw_pg_to_pg_seed(raw_ps)
+    return f"{pool.pool_id}.{ps}"
+
+
+class Objecter:
+    def __init__(self, monc, messenger: Messenger, op_timeout: float = 15.0):
+        self.monc = monc
+        self.messenger = messenger
+        self.op_timeout = op_timeout
+        self._conns: dict[int, Connection] = {}
+        # osd_reqid_t role: a stable id per logical op so retries are
+        # deduped by the primary (append idempotency)
+        self._client_id = os.urandom(6).hex()
+        self._op_seq = itertools.count(1)
+
+    # -- targeting ---------------------------------------------------------
+    def _target(self, pool_id: int, oid: str) -> tuple[str, int]:
+        osdmap = self.monc.osdmap
+        pool = osdmap.pools.get(pool_id)
+        if pool is None:
+            raise ObjecterError(f"pool {pool_id} does not exist")
+        pgid = object_to_pg(pool, oid)
+        ps = int(pgid.split(".")[1])
+        _up, _upp, _acting, primary = osdmap.pg_to_up_acting_osds(
+            pool_id, ps
+        )
+        return pgid, primary
+
+    def _conn_to(self, osd: int) -> Connection:
+        conn = self._conns.get(osd)
+        if conn is not None and not conn._closed:
+            return conn
+        addr = self.monc.osdmap.osd_addrs.get(osd, "")
+        host, _, port = addr.partition(":")
+        if not port:
+            raise MessageError(f"osd.{osd} has no address")
+        conn = self.messenger.connect(host, int(port))
+        self._conns[osd] = conn
+        return conn
+
+    # -- submit ------------------------------------------------------------
+    def op_submit(
+        self,
+        pool_id: int,
+        oid: str,
+        op: int,
+        offset: int = 0,
+        length: int = -1,
+        data: bytes = b"",
+        attr: str = "",
+        pgid: str | None = None,
+    ) -> MOSDOpReply:
+        """Target, send, and retry until acked or timed out."""
+        deadline = time.monotonic() + self.op_timeout
+        last_err = "no attempt"
+        reqid = f"{self._client_id}.{next(self._op_seq)}"
+        while time.monotonic() < deadline:
+            try:
+                tgt_pgid, primary = (
+                    (pgid, self._pg_primary(pgid))
+                    if pgid is not None
+                    else self._target(pool_id, oid)
+                )
+                if primary < 0:
+                    raise MessageError("pg has no primary (all down?)")
+                reply = self._conn_to(primary).call(
+                    MOSDOp(
+                        pool=pool_id, pgid=tgt_pgid, oid=oid, op=op,
+                        offset=offset, length=length, data=data,
+                        attr=attr, reqid=reqid, epoch=self.monc.epoch,
+                    ),
+                    timeout=min(5.0, self.op_timeout),
+                )
+                assert isinstance(reply, MOSDOpReply)
+                if reply.ok:
+                    return reply
+                if "EAGAIN" in reply.error:
+                    last_err = reply.error
+                    # stale target / peering: wait for map movement
+                    time.sleep(0.1)
+                    continue
+                if "ENOENT" in reply.error or "no object" in reply.error:
+                    raise ObjectNotFound(reply.error)
+                raise ObjecterError(reply.error)
+            except (MessageError, OSError) as e:
+                last_err = str(e)
+                time.sleep(0.1)
+                continue
+        raise ObjecterError(
+            f"op on {pool_id}/{oid} timed out: {last_err}"
+        )
+
+    def _pg_primary(self, pgid: str) -> int:
+        pool_id, ps = pgid.split(".")
+        _u, _up, _a, primary = self.monc.osdmap.pg_to_up_acting_osds(
+            int(pool_id), int(ps)
+        )
+        return primary

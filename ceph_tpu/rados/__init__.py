@@ -1,0 +1,182 @@
+"""librados analog — the public client library
+(src/librados/librados_cxx.cc, RadosClient.cc, IoCtxImpl.cc).
+
+``Rados`` opens a cluster session (mon connect + map subscription,
+the RadosClient role); ``IoCtx`` is the per-pool I/O handle with the
+librados core surface: write_full/write/append/read/remove/stat,
+xattrs, object listing, and aio_* variants returning
+``concurrent.futures.Future`` (the librados completion model).
+
+All data ops route through the Objecter (osdc/) to the PG primary
+with retry-on-map-change; pool management routes through the monitor
+command surface exactly like the reference's pool ops.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+
+from ..mon.monitor import MonClient
+from ..msg import Messenger
+from ..msg.message import (
+    OSD_OP_APPEND,
+    OSD_OP_DELETE,
+    OSD_OP_GETXATTR,
+    OSD_OP_LIST,
+    OSD_OP_READ,
+    OSD_OP_SETXATTR,
+    OSD_OP_STAT,
+    OSD_OP_WRITE,
+    OSD_OP_WRITEFULL,
+)
+from ..osdc import Objecter, ObjecterError
+from ..osdc.objecter import ObjectNotFound
+
+__all__ = [
+    "IoCtx",
+    "ObjectNotFound",
+    "Rados",
+    "RadosError",
+]
+
+
+class RadosError(Exception):
+    pass
+
+
+class Rados:
+    """Cluster handle (rados_t / RadosClient)."""
+
+    def __init__(self, name: str = "client"):
+        self.messenger = Messenger(name)
+        self.monc = MonClient(self.messenger, whoami=-1)
+        self.objecter = Objecter(self.monc, self.messenger)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix=f"{name}.aio"
+        )
+        self._connected = False
+
+    def connect(self, mon_host: str, mon_port: int) -> "Rados":
+        self.monc.connect(mon_host, mon_port)
+        self._connected = True
+        return self
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+        self.messenger.shutdown()
+
+    # -- pool surface (rados_pool_*) ---------------------------------------
+    def pool_lookup(self, name: str) -> int:
+        for pool_id, pname in self.monc.osdmap.pool_names.items():
+            if pname == name:
+                return pool_id
+        raise RadosError(f"pool {name!r} does not exist (-ENOENT)")
+
+    def pool_list(self) -> list[str]:
+        return sorted(self.monc.osdmap.pool_names.values())
+
+    def pool_create(self, name: str, **kwargs) -> int:
+        reply = self.monc.command(
+            {"prefix": "osd pool create", "pool": name, **kwargs}
+        )
+        if reply.rc != 0:
+            raise RadosError(reply.outs)
+        epoch = json.loads(reply.outb)["epoch"]
+        self.monc.wait_for_epoch(epoch)
+        return json.loads(reply.outb)["pool_id"]
+
+    def pool_delete(self, name: str) -> None:
+        reply = self.monc.command(
+            {"prefix": "osd pool delete", "pool": name}
+        )
+        if reply.rc != 0:
+            raise RadosError(reply.outs)
+
+    def mon_command(self, cmd: dict):
+        """Raw mon command pass-through (rados_mon_command)."""
+        reply = self.monc.command(cmd)
+        return reply.rc, reply.outb, reply.outs
+
+    def open_ioctx(self, pool_name: str) -> "IoCtx":
+        return IoCtx(self, self.pool_lookup(pool_name))
+
+
+class IoCtx:
+    """Per-pool I/O handle (rados_ioctx_t / IoCtxImpl)."""
+
+    def __init__(self, rados: Rados, pool_id: int):
+        self.rados = rados
+        self.pool_id = pool_id
+
+    # -- sync data ops -----------------------------------------------------
+    def write_full(self, oid: str, data: bytes) -> None:
+        self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_WRITEFULL, data=bytes(data)
+        )
+
+    def write(self, oid: str, data: bytes, offset: int = 0) -> None:
+        self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_WRITE, offset=offset,
+            data=bytes(data),
+        )
+
+    def append(self, oid: str, data: bytes) -> None:
+        """Atomic append: the offset resolves on the primary inside
+        the PG op stream (a client-side stat+write would race
+        concurrent appenders)."""
+        self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_APPEND, data=bytes(data)
+        )
+
+    def read(self, oid: str, length: int = -1, offset: int = 0) -> bytes:
+        reply = self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_READ, offset=offset, length=length
+        )
+        return reply.data
+
+    def remove(self, oid: str) -> None:
+        self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_DELETE
+        )
+
+    def stat(self, oid: str) -> int:
+        reply = self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_STAT
+        )
+        return reply.size
+
+    # -- xattrs ------------------------------------------------------------
+    def set_xattr(self, oid: str, name: str, value: bytes) -> None:
+        self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_SETXATTR, attr=name,
+            data=bytes(value),
+        )
+
+    def get_xattr(self, oid: str, name: str) -> bytes:
+        reply = self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_GETXATTR, attr=name
+        )
+        return reply.data
+
+    # -- listing (rados_nobjects_list*, the pgls walk) ---------------------
+    def list_objects(self) -> list[str]:
+        pool = self.rados.monc.osdmap.pools[self.pool_id]
+        names: set[str] = set()
+        for ps in range(pool.pg_num):
+            pgid = f"{self.pool_id}.{ps}"
+            reply = self.rados.objecter.op_submit(
+                self.pool_id, "", OSD_OP_LIST, pgid=pgid
+            )
+            names.update(reply.names)
+        return sorted(names)
+
+    # -- async (librados completions) --------------------------------------
+    def aio_write_full(self, oid: str, data: bytes):
+        return self.rados._pool.submit(self.write_full, oid, data)
+
+    def aio_read(self, oid: str, length: int = -1, offset: int = 0):
+        return self.rados._pool.submit(self.read, oid, length, offset)
+
+    def aio_remove(self, oid: str):
+        return self.rados._pool.submit(self.remove, oid)

@@ -91,6 +91,9 @@ class ObjectStore:
     def list_collections(self) -> list[str]:
         raise NotImplementedError
 
+    def list_attrs(self, cid: str, oid: str) -> dict[str, bytes]:
+        raise NotImplementedError
+
 
 class _TxnState:
     """Shadow state for one transaction: copies only the objects the
@@ -256,6 +259,13 @@ class MemStore(ObjectStore):
     def list_collections(self) -> list[str]:
         with self._lock:
             return sorted(self._colls)
+
+    def list_attrs(self, cid, oid) -> dict[str, bytes]:
+        with self._lock:
+            obj = self._colls.get(cid, {}).get(oid)
+            if obj is None:
+                raise StoreError(f"no object {cid}/{oid} (-ENOENT)")
+            return dict(obj.xattrs)
 
     def list_objects(self, cid) -> list[str]:
         with self._lock:
