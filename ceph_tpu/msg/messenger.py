@@ -50,6 +50,7 @@ class Connection:
         self._writer = writer
         self.outgoing = outgoing
         self.peer_addr = writer.get_extra_info("peername")
+        self.peer_entity = ""  # authenticated cephx entity ('' = none)
         # pending replies are concurrent futures: resolved from the
         # loop thread, awaited from caller threads (thread-safe both
         # ways, unlike asyncio futures)
@@ -67,9 +68,17 @@ class Connection:
         self, msg: Message, timeout: float = _CALL_TIMEOUT
     ) -> Message:
         """Send and wait for the tid-paired reply (sub-op pattern).
-        Raises MessageError on connection loss or timeout."""
+        Raises MessageError on connection loss or timeout.
+
+        Request tids live in direction-disjoint spaces (dialer odd,
+        acceptor even) so nested RPC initiated from BOTH ends of one
+        socket can never collide in the tid-routed read loops."""
         if msg.tid == 0:
-            msg.tid = self.msgr.new_tid()
+            msg.tid = (
+                self.msgr.new_tid()
+                if self.outgoing
+                else self.msgr.new_even_tid()
+            )
         cf: concurrent.futures.Future = concurrent.futures.Future()
         with self._plock:
             if self._closed:
@@ -157,9 +166,16 @@ class Connection:
 
 
 class Messenger:
-    """Messenger::create + bind/start/shutdown lifecycle."""
+    """Messenger::create + bind/start/shutdown lifecycle.
 
-    def __init__(self, name: str = "client"):
+    ``auth_server`` (a CephxServiceHandler) makes inbound connections
+    demand a cephx authorizer after the banner; ``auth_client`` (a
+    ticket-holding CephxClientHandler) satisfies such demands on
+    outbound connections and verifies the server's proof back (mutual
+    auth).  Both None = AUTH_NONE, the reference's
+    auth_cluster_required=none mode (AuthRegistry negotiation)."""
+
+    def __init__(self, name: str = "client", auth_server=None, auth_client=None):
         self.name = name
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
@@ -168,6 +184,8 @@ class Messenger:
         self._conns: set[Connection] = set()
         self._tid = 0
         self._tid_lock = threading.Lock()
+        self.auth_server = auth_server
+        self.auth_client = auth_client
         self.bound_addr: tuple[str, int] | None = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -208,6 +226,34 @@ class Messenger:
             if peer != BANNER:
                 writer.close()
                 raise MessageError("banner mismatch")
+            mode = await reader.readexactly(1)
+            if mode == b"A":
+                # server demands a cephx authorizer; its 16-byte
+                # challenge follows (CEPHX_V2 anti-replay)
+                challenge = await reader.readexactly(16)
+                if self.auth_client is None:
+                    writer.close()
+                    raise MessageError(
+                        "server requires cephx auth, no ticket held"
+                    )
+                blob, nonce = self.auth_client.build_authorizer(challenge)
+                writer.write(len(blob).to_bytes(4, "little") + blob)
+                await writer.drain()
+                plen = int.from_bytes(await reader.readexactly(4), "little")
+                if plen == 0:
+                    writer.close()
+                    raise MessageError("cephx authorizer rejected")
+                proof = await reader.readexactly(plen)
+                from ..auth.cephx import AuthError
+
+                try:
+                    self.auth_client.verify_server(challenge, nonce, proof)
+                except AuthError as e:
+                    writer.close()
+                    raise MessageError(f"server auth failed: {e}")
+            elif mode != b"N":
+                writer.close()
+                raise MessageError("bad auth negotiation byte")
             conn = Connection(self, reader, writer, outgoing=True)
             self._conns.add(conn)
             self._loop.create_task(conn._read_loop())
@@ -273,9 +319,17 @@ class Messenger:
 
     # -- internals ---------------------------------------------------------
     def new_tid(self) -> int:
+        """Odd tid space: dialer-side requests and fire-and-forget."""
         with self._tid_lock:
             self._tid += 1
-            return self._tid
+            return self._tid * 2 + 1
+
+    def new_even_tid(self) -> int:
+        """Even tid space: requests initiated from the ACCEPTING side
+        of a connection (e.g. a replica's rollback re-pulls)."""
+        with self._tid_lock:
+            self._tid += 1
+            return self._tid * 2
 
     def _run(self, coro):
         if self._loop is None:
@@ -283,6 +337,7 @@ class Messenger:
         return asyncio.run_coroutine_threadsafe(coro, self._loop)
 
     async def _accept(self, reader, writer) -> None:
+        peer_entity = ""
         try:
             writer.write(BANNER)
             await writer.drain()
@@ -292,10 +347,43 @@ class Messenger:
             if peer != BANNER:
                 writer.close()
                 return
+            if self.auth_server is not None:
+                challenge = self.auth_server.make_challenge()
+                writer.write(b"A" + challenge)
+                await writer.drain()
+                blen = int.from_bytes(
+                    await asyncio.wait_for(reader.readexactly(4), 10),
+                    "little",
+                )
+                blob = await asyncio.wait_for(
+                    reader.readexactly(blen), 10
+                )
+                from ..auth.cephx import AuthError
+
+                try:
+                    peer_entity, proof = (
+                        self.auth_server.verify_authorizer(
+                            blob, challenge
+                        )
+                    )
+                except AuthError:
+                    # reject: zero-length proof then close
+                    writer.write((0).to_bytes(4, "little"))
+                    await writer.drain()
+                    writer.close()
+                    return
+                writer.write(
+                    len(proof).to_bytes(4, "little") + proof
+                )
+                await writer.drain()
+            else:
+                writer.write(b"N")
+                await writer.drain()
         except Exception:
             writer.close()
             return
         conn = Connection(self, reader, writer, outgoing=False)
+        conn.peer_entity = peer_entity
         self._conns.add(conn)
         await conn._read_loop()
 

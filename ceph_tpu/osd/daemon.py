@@ -138,6 +138,9 @@ class PG:
         # the (acting, primary) interval last peered, so unrelated
         # epoch bumps don't trigger a re-peering RPC storm
         self.peered_interval: tuple | None = None
+        # recently applied client reqids (the pg log dups role):
+        # outlives trimmed entries so a late retry still dedups
+        self.reqid_cache: dict[str, tuple[int, int]] = {}
 
 
 class OSD(Dispatcher):
@@ -543,15 +546,13 @@ class OSD(Dispatcher):
         same transaction to the acting peers (issue_repop).  Raises
         StoreError to surface op errors; replica failures surface as
         -EAGAIN so the client retries after the interval changes."""
-        if msg.reqid and any(
-            e.reqid == msg.reqid for e in pg.log.entries
-        ):
-            return  # retried op already applied (osd_reqid_t dedup)
+        if msg.reqid and msg.reqid in pg.reqid_cache:
+            return  # retried op already applied (osd_reqid_t dedup;
+            # the cache outlives log trimming, like the log's dups)
         existed = self.store.exists(pg.cid, store_oid)
         if msg.op == OSD_OP_DELETE and not existed:
-            last = pg.log.object_op(msg.oid)
-            if last is not None and last.op == DELETE:
-                return  # idempotent delete (retried op)
+            # only the SAME client op retried is idempotent; a fresh
+            # delete of a missing object is -ENOENT (rados semantics)
             raise StoreError(f"no object {msg.oid} (-ENOENT)")
         pg.seq += 1
         version = (epoch, pg.seq)
@@ -603,6 +604,10 @@ class OSD(Dispatcher):
             pg.seq -= 1
             raise
         pg.log.append(entry)
+        if msg.reqid:
+            pg.reqid_cache[msg.reqid] = version
+            while len(pg.reqid_cache) > 4 * self.log_keep:
+                pg.reqid_cache.pop(next(iter(pg.reqid_cache)))
         entry_blob = _encode_entry(entry)
         failed: list[int] = []
         for osd in pg.acting:
@@ -673,6 +678,9 @@ class OSD(Dispatcher):
                 pg.log.append(entry)
             pg.info.last_update = pg.log.head
             pg.seq = max(pg.seq, entry.version[1])
+            # replicas bound their logs too (the primary's trim txn is
+            # local; unbounded replica logs would grow forever)
+            self._maybe_trim(pg)
         except StoreError as e:
             reply.ok = False
             reply.error = str(e)

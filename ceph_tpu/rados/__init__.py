@@ -82,9 +82,9 @@ class Rados:
         )
         if reply.rc != 0:
             raise RadosError(reply.outs)
-        epoch = json.loads(reply.outb)["epoch"]
-        self.monc.wait_for_epoch(epoch)
-        return json.loads(reply.outb)["pool_id"]
+        out = json.loads(reply.outb)
+        self.monc.wait_for_epoch(out["epoch"])
+        return out["pool_id"]
 
     def pool_delete(self, name: str) -> None:
         reply = self.monc.command(

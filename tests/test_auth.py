@@ -1,0 +1,172 @@
+"""cephx-analog auth tests (src/auth/cephx/CephxProtocol.cc): ticket
+issue/verify, mutual auth, rejection paths, and the messenger
+handshake integration."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.auth import (
+    AuthError,
+    CephxClientHandler,
+    CephxServiceHandler,
+    CryptoKey,
+    Keyring,
+    Ticket,
+)
+from ceph_tpu.msg import Messenger, MessageError, MPing
+
+
+def test_crypto_roundtrip_and_tamper():
+    key = CryptoKey()
+    blob = key.encrypt(b"secret payload" * 10)
+    assert key.decrypt(blob) == b"secret payload" * 10
+    bad = bytearray(blob)
+    bad[20] ^= 1
+    with pytest.raises(AuthError):
+        key.decrypt(bytes(bad))
+    with pytest.raises(AuthError):
+        CryptoKey().decrypt(blob)  # wrong key
+
+
+def test_ticket_flow_and_mutual_auth():
+    keyring = Keyring()
+    client_key = keyring.add("client.admin")
+    svc = CephxServiceHandler(keyring)
+
+    client = CephxClientHandler("client.admin", client_key)
+    client.handle_response(svc.issue_ticket("client.admin"))
+    challenge = svc.make_challenge()
+    blob, nonce = client.build_authorizer(challenge)
+    entity, proof = svc.verify_authorizer(blob, challenge)
+    assert entity == "client.admin"
+    client.verify_server(challenge, nonce, proof)  # mutual
+    with pytest.raises(AuthError):
+        client.verify_server(challenge, nonce, b"x" * 32)
+    # anti-replay: the same authorizer fails a DIFFERENT connection's
+    # challenge (the CEPHX_V2 server challenge)
+    with pytest.raises(AuthError):
+        svc.verify_authorizer(blob, svc.make_challenge())
+
+
+def test_unknown_entity_and_expired_ticket():
+    keyring = Keyring()
+    keyring.add("osd.0")
+    svc = CephxServiceHandler(keyring)
+    with pytest.raises(AuthError):
+        svc.issue_ticket("client.rogue")
+    client = CephxClientHandler("osd.0", keyring.get("osd.0"))
+    client.handle_response(svc.issue_ticket("osd.0", ttl=-1))
+    ch = svc.make_challenge()
+    blob, _ = client.build_authorizer(ch)
+    with pytest.raises(AuthError):
+        svc.verify_authorizer(blob, ch)
+
+
+def test_forged_ticket_rejected():
+    """A client cannot mint its own ticket: the ticket is sealed under
+    the service rotating key it never sees."""
+    keyring = Keyring()
+    key = keyring.add("client.admin")
+    svc = CephxServiceHandler(keyring)
+    client = CephxClientHandler("client.admin", key)
+    client.handle_response(svc.issue_ticket("client.admin"))
+    # forge: replace the ticket blob with one sealed under a key the
+    # attacker controls
+    fake = Ticket(
+        entity="client.admin", session_key=b"k" * 32,
+        expires=time.time() + 999,
+    )
+    client.ticket_blob = CryptoKey().encrypt(fake.encode())
+    ch = svc.make_challenge()
+    blob, _ = client.build_authorizer(ch)
+    with pytest.raises(AuthError):
+        svc.verify_authorizer(blob, ch)
+
+
+def test_messenger_cephx_handshake():
+    keyring = Keyring()
+    good_key = keyring.add("client.good")
+    svc = CephxServiceHandler(keyring)
+
+    server = Messenger("authed-server", auth_server=svc)
+
+    class Echo:
+        def ms_dispatch(self, conn, msg):
+            if isinstance(msg, MPing) and not msg.is_reply:
+                conn.send(MPing(tid=msg.tid, from_osd=99,
+                                stamp=msg.stamp, is_reply=True))
+                return True
+            return False
+
+        def ms_handle_reset(self, conn):
+            pass
+
+    server.add_dispatcher(Echo())
+    host, port = server.bind()
+
+    good = CephxClientHandler("client.good", good_key)
+    good.handle_response(svc.issue_ticket("client.good"))
+    client = Messenger("good-client", auth_client=good)
+    try:
+        conn = client.connect(host, port)
+        assert isinstance(conn.call(MPing(stamp=1.0)), MPing)
+
+        # no ticket at all → refused at negotiation
+        bare = Messenger("bare-client")
+        with pytest.raises(MessageError):
+            bare.connect(host, port)
+        bare.shutdown()
+
+        # wrong key → authorizer rejected
+        evil = CephxClientHandler("client.good", CryptoKey())
+        evil.session = CryptoKey()
+        evil.ticket_blob = b"garbage-ticket-bytes" * 3
+        evil_m = Messenger("evil-client", auth_client=evil)
+        with pytest.raises(MessageError):
+            evil_m.connect(host, port)
+        evil_m.shutdown()
+
+        # AUTH_NONE servers still accept anyone (negotiation byte N)
+        plain = Messenger("plain-server")
+        plain.add_dispatcher(Echo())
+        h2, p2 = plain.bind()
+        c2 = Messenger("c2")
+        conn2 = c2.connect(h2, p2)
+        assert isinstance(conn2.call(MPing(stamp=2.0)), MPing)
+        c2.shutdown()
+        plain.shutdown()
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_authenticated_entity_visible_on_connection():
+    keyring = Keyring()
+    key = keyring.add("osd.7")
+    svc = CephxServiceHandler(keyring)
+    seen = []
+
+    class Capture:
+        def ms_dispatch(self, conn, msg):
+            seen.append(conn.peer_entity)
+            conn.send(MPing(tid=msg.tid, is_reply=True))
+            return True
+
+        def ms_handle_reset(self, conn):
+            pass
+
+    server = Messenger("cap-server", auth_server=svc)
+    server.add_dispatcher(Capture())
+    host, port = server.bind()
+    handler = CephxClientHandler("osd.7", key)
+    handler.handle_response(svc.issue_ticket("osd.7"))
+    client = Messenger("cap-client", auth_client=handler)
+    try:
+        client.connect(host, port).call(MPing(stamp=3.0))
+        assert seen == ["osd.7"]
+    finally:
+        client.shutdown()
+        server.shutdown()

@@ -85,12 +85,11 @@ class MiniCluster:
         )
         return primary
 
-    _op_seq = 0
+    _op_seq = __import__("itertools").count(1)
 
     def op(self, pgid: str, oid: str, op, data=b"", timeout=10.0):
         deadline = time.monotonic() + timeout
-        MiniCluster._op_seq += 1
-        reqid = f"test.{MiniCluster._op_seq}"  # stable across retries
+        reqid = f"test.{next(MiniCluster._op_seq)}"  # stable across retries
         while time.monotonic() < deadline:
             primary = self.primary_of(pgid)
             osd = self.osds.get(primary)
