@@ -1,0 +1,227 @@
+"""Object classes (cls) — in-OSD stored procedures
+(src/cls/, src/objclass/class_api.cc, src/osd/ClassHandler.cc).
+
+The reference loads ``libcls_*.so`` modules into the OSD; pools call
+their methods through CEPH_OSD_OP_CALL (PrimaryLogPG::do_osd_ops →
+ClassHandler dispatch).  Here classes self-register with the
+``ClassHandler`` registry (the dlopen role, same pattern as the EC
+and compressor registries) and methods declare RD/WR flags exactly
+like cls_register_cxx_method.
+
+A method receives a ``MethodContext`` exposing the object primitives
+(cls_cxx_read/stat/getxattr/...); WRITE methods stage mutations
+(write_full / setxattr / remove) that the OSD folds into the SAME
+replicated, logged transaction as any client write — a failed method
+aborts with no side effects, matching the reference's all-or-nothing
+op semantics.
+
+Built-ins mirror the reference's most-used classes: ``hello``
+(cls_hello), ``lock`` (cls_lock: exclusive/shared cooperative locks),
+``version`` (cls_version: monotone object versions), ``log``
+(cls_log: timestamped appends with trim).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+__all__ = [
+    "ClassError",
+    "ClassHandler",
+    "MethodContext",
+    "RD",
+    "WR",
+    "default_handler",
+]
+
+RD = 1  # CLS_METHOD_RD
+WR = 2  # CLS_METHOD_WR
+
+
+class ClassError(Exception):
+    """Method failure — surfaces to the client as an op error."""
+
+
+class MethodContext:
+    """The objclass API surface handed to methods (class_api.cc):
+    reads hit the live object; writes stage into the op's transaction."""
+
+    def __init__(self, read_fn, attrs: dict[str, bytes], exists: bool):
+        self._read = read_fn
+        self._attrs = dict(attrs)
+        self.exists = exists
+        # staged mutations the OSD materializes into the txn
+        self.new_data: bytes | None = None
+        self.new_attrs: dict[str, bytes] = {}
+        self.removed = False
+
+    # -- reads (cls_cxx_read / stat / getxattr) ----------------------------
+    def read(self) -> bytes:
+        if self.new_data is not None:
+            return self.new_data
+        return self._read() if self.exists else b""
+
+    def stat(self) -> int:
+        return len(self.read())
+
+    def getxattr(self, name: str) -> bytes | None:
+        if name in self.new_attrs:
+            return self.new_attrs[name]
+        return self._attrs.get(name)
+
+    # -- staged writes (cls_cxx_write_full / setxattr / remove) ------------
+    def write_full(self, data: bytes) -> None:
+        self.new_data = bytes(data)
+        self.removed = False
+
+    def setxattr(self, name: str, value: bytes) -> None:
+        self.new_attrs[name] = bytes(value)
+
+    def remove(self) -> None:
+        self.removed = True
+        self.new_data = None
+
+
+class ClassHandler:
+    """class/method registry (ClassHandler.cc + cls_register)."""
+
+    def __init__(self):
+        self._classes: dict[str, dict[str, tuple[int, object]]] = {}
+
+    def register(self, cls: str, method: str, flags: int, fn) -> None:
+        self._classes.setdefault(cls, {})[method] = (flags, fn)
+
+    def cls_method(self, cls: str, method: str, flags: int):
+        def deco(fn):
+            self.register(cls, method, flags, fn)
+            return fn
+
+        return deco
+
+    def flags_of(self, cls: str, method: str) -> int:
+        entry = self._classes.get(cls, {}).get(method)
+        if entry is None:
+            raise ClassError(
+                f"class {cls!r} method {method!r} not found (-EOPNOTSUPP)"
+            )
+        return entry[0]
+
+    def call(
+        self, cls: str, method: str, ctx: MethodContext, indata: bytes
+    ) -> bytes:
+        flags, fn = self._classes.get(cls, {}).get(method, (0, None))
+        if fn is None:
+            raise ClassError(
+                f"class {cls!r} method {method!r} not found (-EOPNOTSUPP)"
+            )
+        return fn(ctx, indata) or b""
+
+    def classes(self) -> list[str]:
+        return sorted(self._classes)
+
+
+default_handler = ClassHandler()
+
+
+# -- built-in classes ------------------------------------------------------
+
+_LOCK_ATTR = "cls_lock"
+
+
+@default_handler.cls_method("hello", "say_hello", RD)
+def _hello(ctx: MethodContext, indata: bytes) -> bytes:
+    """cls_hello's say_hello (src/cls/hello/cls_hello.cc)."""
+    name = indata.decode() or "world"
+    return f"Hello, {name}!".encode()
+
+
+@default_handler.cls_method("hello", "record_hello", WR)
+def _record_hello(ctx: MethodContext, indata: bytes) -> bytes:
+    ctx.write_full(b"Hello, " + (indata or b"world") + b"!")
+    return b""
+
+
+def _lock_state(ctx: MethodContext) -> dict:
+    raw = ctx.getxattr(_LOCK_ATTR)
+    return json.loads(raw) if raw else {"type": "", "holders": {}}
+
+
+@default_handler.cls_method("lock", "lock", WR)
+def _lock(ctx: MethodContext, indata: bytes) -> bytes:
+    """cls_lock lock_op: exclusive or shared cooperative lock."""
+    req = json.loads(indata)
+    name, typ = req["cookie"], req.get("type", "exclusive")
+    state = _lock_state(ctx)
+    if state["holders"]:
+        if typ == "exclusive":
+            # exclusive needs to be the SOLE holder (an upgrade while
+            # other shared holders remain would not be exclusive)
+            if set(state["holders"]) != {name}:
+                raise ClassError("object is locked (-EBUSY)")
+        elif state["type"] == "exclusive":
+            if name not in state["holders"]:
+                raise ClassError("object is locked (-EBUSY)")
+    state["type"] = typ
+    state["holders"][name] = time.time()
+    ctx.setxattr(_LOCK_ATTR, json.dumps(state).encode())
+    return b""
+
+
+@default_handler.cls_method("lock", "unlock", WR)
+def _unlock(ctx: MethodContext, indata: bytes) -> bytes:
+    req = json.loads(indata)
+    state = _lock_state(ctx)
+    if req["cookie"] not in state["holders"]:
+        raise ClassError("no such lock holder (-ENOENT)")
+    del state["holders"][req["cookie"]]
+    if not state["holders"]:
+        state["type"] = ""
+    ctx.setxattr(_LOCK_ATTR, json.dumps(state).encode())
+    return b""
+
+
+@default_handler.cls_method("lock", "get_info", RD)
+def _lock_info(ctx: MethodContext, indata: bytes) -> bytes:
+    return json.dumps(_lock_state(ctx)).encode()
+
+
+@default_handler.cls_method("version", "set", WR)
+def _version_set(ctx: MethodContext, indata: bytes) -> bytes:
+    ctx.setxattr("cls_version", indata)
+    return b""
+
+
+@default_handler.cls_method("version", "inc", WR)
+def _version_inc(ctx: MethodContext, indata: bytes) -> bytes:
+    cur = int(ctx.getxattr("cls_version") or b"0")
+    ctx.setxattr("cls_version", str(cur + 1).encode())
+    return str(cur + 1).encode()
+
+
+@default_handler.cls_method("version", "read", RD)
+def _version_read(ctx: MethodContext, indata: bytes) -> bytes:
+    return ctx.getxattr("cls_version") or b"0"
+
+
+@default_handler.cls_method("log", "add", WR)
+def _log_add(ctx: MethodContext, indata: bytes) -> bytes:
+    """cls_log add: timestamped line appended to the object."""
+    line = json.dumps(
+        {"stamp": time.time(), "entry": indata.decode()}
+    ).encode()
+    ctx.write_full(ctx.read() + line + b"\n")
+    return b""
+
+
+@default_handler.cls_method("log", "list", RD)
+def _log_list(ctx: MethodContext, indata: bytes) -> bytes:
+    return ctx.read()
+
+
+@default_handler.cls_method("log", "trim", WR)
+def _log_trim(ctx: MethodContext, indata: bytes) -> bytes:
+    keep = int(indata or b"0")
+    lines = ctx.read().splitlines(keepends=True)
+    ctx.write_full(b"".join(lines[len(lines) - keep :] if keep else []))
+    return b""

@@ -364,6 +364,7 @@ OSD_OP_SETXATTR = 5  # oid attr (in .oid/.attr), value in .data
 OSD_OP_GETXATTR = 6
 OSD_OP_LIST = 7  # list this PG's objects (the pgls op)
 OSD_OP_APPEND = 8  # atomic append (offset resolved on the primary)
+OSD_OP_CALL = 9  # object-class call (attr='cls.method', data=indata)
 
 
 @register_message

@@ -65,6 +65,7 @@ from ..msg import (
 )
 from ..msg.message import (
     OSD_OP_APPEND,
+    OSD_OP_CALL,
     OSD_OP_DELETE,
     OSD_OP_GETXATTR,
     OSD_OP_LIST,
@@ -75,6 +76,7 @@ from ..msg.message import (
     OSD_OP_WRITEFULL,
 )
 from ..msg.messenger import Connection, Dispatcher
+from ..cls import RD as CLS_RD, WR as CLS_WR, ClassError, MethodContext, default_handler
 from ..mon.monitor import MonClient
 from ..store.objectstore import MemStore, ObjectStore, StoreError, Transaction
 from .failure import HeartbeatTracker
@@ -138,9 +140,10 @@ class PG:
         # the (acting, primary) interval last peered, so unrelated
         # epoch bumps don't trigger a re-peering RPC storm
         self.peered_interval: tuple | None = None
-        # recently applied client reqids (the pg log dups role):
-        # outlives trimmed entries so a late retry still dedups
-        self.reqid_cache: dict[str, tuple[int, int]] = {}
+        # recently applied client reqids → (version, outdata) (the
+        # pg log dups role): outlives trimmed entries so a late retry
+        # still dedups AND replays its original result
+        self.reqid_cache: dict[str, tuple] = {}
 
 
 class OSD(Dispatcher):
@@ -169,6 +172,7 @@ class OSD(Dispatcher):
         self.hb = HeartbeatTracker(whoami, grace=heartbeat_grace)
         self.tick_interval = tick_interval
         self.log_keep = 128  # pg_log length bound (osd_min_pg_log_entries role)
+        self.class_handler = default_handler  # ClassHandler role
         self.addr: tuple[str, int] | None = None
         # peers this OSD has filed failure reports for (to withdraw
         # with failed_for=-1 when they speak again — send_still_alive)
@@ -528,6 +532,16 @@ class OSD(Dispatcher):
                 reply.data = self.store.getattr(
                     pg.cid, store_oid, "u_" + msg.attr
                 )
+            elif msg.op == OSD_OP_CALL:
+                cls_name, _, method = msg.attr.partition(".")
+                flags = self.class_handler.flags_of(cls_name, method)
+                if flags & CLS_WR:
+                    reply.data = self._mutate(pg, epoch, msg, store_oid)
+                else:
+                    ctx = self._cls_ctx(pg, store_oid)
+                    reply.data = self._cls_call(
+                        cls_name, method, ctx, msg.data
+                    )
             elif msg.op == OSD_OP_LIST:
                 reply.names = sorted(
                     o[len(OBJ_PREFIX):]
@@ -536,10 +550,41 @@ class OSD(Dispatcher):
                 )
             else:
                 self._mutate(pg, epoch, msg, store_oid)
-        except StoreError as e:
+        except (StoreError, ClassError) as e:
             reply.ok = False
             reply.error = str(e)
         conn.send(reply)
+
+    def _cls_call(self, cls_name, method, ctx, indata) -> bytes:
+        """Run a stored procedure, converting ANY method exception to
+        ClassError — methods execute arbitrary code on
+        client-controlled bytes and must never kill the op path or
+        leave the client without a reply."""
+        try:
+            return self.class_handler.call(cls_name, method, ctx, indata)
+        except ClassError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            raise ClassError(
+                f"{cls_name}.{method} failed: {type(e).__name__}: {e}"
+            )
+
+    def _cls_ctx(self, pg: PG, store_oid: str) -> MethodContext:
+        exists = self.store.exists(pg.cid, store_oid)
+        attrs = {}
+        if exists:
+            attrs = {
+                k[2:]: v
+                for k, v in self.store.list_attrs(
+                    pg.cid, store_oid
+                ).items()
+                if k.startswith("c_")
+            }
+        return MethodContext(
+            read_fn=lambda: self.store.read(pg.cid, store_oid),
+            attrs=attrs,
+            exists=exists,
+        )
 
     def _mutate(self, pg: PG, epoch: int, msg: MOSDOp, store_oid: str):
         """Append a log entry + apply data in ONE transaction, fan the
@@ -547,16 +592,29 @@ class OSD(Dispatcher):
         StoreError to surface op errors; replica failures surface as
         -EAGAIN so the client retries after the interval changes."""
         if msg.reqid and msg.reqid in pg.reqid_cache:
-            return  # retried op already applied (osd_reqid_t dedup;
-            # the cache outlives log trimming, like the log's dups)
+            # retried op already applied (osd_reqid_t dedup; the cache
+            # outlives log trimming, like the log's dups) — replay the
+            # original outdata so retried CALLs keep their result
+            return pg.reqid_cache[msg.reqid][1]
         existed = self.store.exists(pg.cid, store_oid)
         if msg.op == OSD_OP_DELETE and not existed:
             # only the SAME client op retried is idempotent; a fresh
             # delete of a missing object is -ENOENT (rados semantics)
             raise StoreError(f"no object {msg.oid} (-ENOENT)")
+        ctx = None
+        outdata = b""
+        if msg.op == OSD_OP_CALL:
+            # run the stored procedure BEFORE any state advances: a
+            # method failure must leave no trace (no seq bump, no log
+            # entry, no transaction)
+            cls_name, _, method = msg.attr.partition(".")
+            ctx = self._cls_ctx(pg, store_oid)
+            outdata = self._cls_call(cls_name, method, ctx, msg.data)
         pg.seq += 1
         version = (epoch, pg.seq)
-        op = DELETE if msg.op == OSD_OP_DELETE else MODIFY
+        op = DELETE if (
+            msg.op == OSD_OP_DELETE
+        ) else MODIFY
         prior = pg.log.object_op(msg.oid)
         entry = LogEntry(
             op=op, oid=msg.oid, version=version, reqid=msg.reqid,
@@ -588,6 +646,35 @@ class OSD(Dispatcher):
         elif msg.op == OSD_OP_SETXATTR:
             txn.touch(pg.cid, store_oid)
             txn.setattr(pg.cid, store_oid, "u_" + msg.attr, msg.data)
+        elif msg.op == OSD_OP_CALL:
+            # fold the staged mutations into THIS logged, replicated
+            # transaction (do_osd_ops CEPH_OSD_OP_CALL)
+            if ctx.removed:
+                if existed:
+                    txn.remove(pg.cid, store_oid)
+            else:
+                surviving: dict[str, bytes] = {}
+                if ctx.new_data is not None:
+                    if existed:
+                        # a rewrite must not destroy the object's
+                        # OTHER attrs (user xattrs included) —
+                        # cls_cxx_write_full keeps them
+                        surviving = self.store.list_attrs(
+                            pg.cid, store_oid
+                        )
+                        txn.remove(pg.cid, store_oid)
+                    txn.touch(pg.cid, store_oid)
+                    if ctx.new_data:
+                        txn.write(pg.cid, store_oid, 0, ctx.new_data)
+                elif not existed:
+                    txn.touch(pg.cid, store_oid)
+                for k, v in surviving.items():
+                    if not (
+                        k.startswith("c_") and k[2:] in ctx.new_attrs
+                    ):
+                        txn.setattr(pg.cid, store_oid, k, v)
+                for k, v in ctx.new_attrs.items():
+                    txn.setattr(pg.cid, store_oid, "c_" + k, v)
         elif msg.op == OSD_OP_DELETE:
             txn.remove(pg.cid, store_oid)
         self._persist_entry(pg, entry, txn)
@@ -605,7 +692,7 @@ class OSD(Dispatcher):
             raise
         pg.log.append(entry)
         if msg.reqid:
-            pg.reqid_cache[msg.reqid] = version
+            pg.reqid_cache[msg.reqid] = (version, outdata)
             while len(pg.reqid_cache) > 4 * self.log_keep:
                 pg.reqid_cache.pop(next(iter(pg.reqid_cache)))
         entry_blob = _encode_entry(entry)
@@ -641,6 +728,7 @@ class OSD(Dispatcher):
                 f"replicas {live_failures} missed the write (-EAGAIN)"
             )
         self._maybe_trim(pg)
+        return outdata
 
     def _maybe_trim(self, pg: PG) -> None:
         """Bound the pg log (PGLog::trim), removing the trimmed

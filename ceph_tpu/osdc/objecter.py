@@ -23,7 +23,11 @@ from ..msg import Messenger, MessageError, MOSDOp, MOSDOpReply
 from ..msg.messenger import Connection
 
 
-class ObjecterError(Exception):
+class RadosError(Exception):
+    """Base for every client-visible error (librados' rados.Error)."""
+
+
+class ObjecterError(RadosError):
     pass
 
 

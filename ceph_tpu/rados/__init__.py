@@ -21,6 +21,7 @@ from ..mon.monitor import MonClient
 from ..msg import Messenger
 from ..msg.message import (
     OSD_OP_APPEND,
+    OSD_OP_CALL,
     OSD_OP_DELETE,
     OSD_OP_GETXATTR,
     OSD_OP_LIST,
@@ -30,8 +31,7 @@ from ..msg.message import (
     OSD_OP_WRITE,
     OSD_OP_WRITEFULL,
 )
-from ..osdc import Objecter, ObjecterError
-from ..osdc.objecter import ObjectNotFound
+from ..osdc import Objecter, ObjecterError, ObjectNotFound, RadosError
 
 __all__ = [
     "IoCtx",
@@ -39,10 +39,6 @@ __all__ = [
     "Rados",
     "RadosError",
 ]
-
-
-class RadosError(Exception):
-    pass
 
 
 class Rados:
@@ -156,6 +152,17 @@ class IoCtx:
     def get_xattr(self, oid: str, name: str) -> bytes:
         reply = self.rados.objecter.op_submit(
             self.pool_id, oid, OSD_OP_GETXATTR, attr=name
+        )
+        return reply.data
+
+    def execute(
+        self, oid: str, cls: str, method: str, indata: bytes = b""
+    ) -> bytes:
+        """Object-class call (rados_exec / IoCtx::exec → the in-OSD
+        ClassHandler dispatch)."""
+        reply = self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_CALL,
+            attr=f"{cls}.{method}", data=bytes(indata),
         )
         return reply.data
 

@@ -48,10 +48,22 @@ _SNAP_MAGIC = 0x4B53544F  # "KSTO"
 class KStore(MemStore):
     """File-backed store; state in RAM, durability via WAL+snapshot."""
 
-    def __init__(self, path: str | os.PathLike, sync: bool = True):
+    def __init__(
+        self,
+        path: str | os.PathLike,
+        sync: bool = True,
+        compression: str = "none",
+    ):
+        """``compression`` names a compressor plugin for checkpoint
+        blobs (the BlueStore blob-compression role at this store's
+        granularity); snapshots record their codec, so a store written
+        with one codec mounts under any configuration."""
         super().__init__()
         self.path = pathlib.Path(path)
         self.sync = sync
+        from ..compressor import create as compressor_create
+
+        self.compressor = compressor_create(compression)
         self.path.mkdir(parents=True, exist_ok=True)
         self._wal_lock = threading.Lock()
         self._mount()
@@ -129,6 +141,12 @@ class KStore(MemStore):
                     lambda e2, v: e2.bytes(v),
                 )
         body = e.getvalue()
+        codec = self.compressor.name.encode()
+        body = (
+            len(codec).to_bytes(1, "little")
+            + codec
+            + self.compressor.compress(body)
+        )
         return body + ceph_crc32c(0, body).to_bytes(4, "little")
 
     def _load_snapshot(self, blob: bytes) -> None:
@@ -139,6 +157,24 @@ class KStore(MemStore):
         body, crc = blob[:-4], int.from_bytes(blob[-4:], "little")
         if ceph_crc32c(0, body) != crc:
             raise DecodeError("snapshot crc mismatch")
+        from ..compressor import CompressorError, create as compressor_create
+
+        if len(body) >= 4 and int.from_bytes(
+            body[:4], "little"
+        ) == _SNAP_MAGIC:
+            # legacy pre-compression snapshot: magic-first, raw body
+            pass
+        else:
+            if len(body) < 1 or body[0] > 32:
+                raise DecodeError("bad snapshot codec header")
+            clen = body[0]
+            try:
+                codec = body[1 : 1 + clen].decode("ascii")
+                body = compressor_create(codec).decompress(
+                    body[1 + clen :]
+                )
+            except (CompressorError, UnicodeDecodeError) as e:
+                raise DecodeError(f"snapshot decompress: {e}")
         d = Decoder(body)
         if d.u32() != _SNAP_MAGIC:
             raise DecodeError("bad snapshot magic")
