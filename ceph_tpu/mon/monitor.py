@@ -118,6 +118,8 @@ class Monitor(Dispatcher):
         )
         # subscribers: conn -> last epoch sent
         self._subs: dict[Connection, int] = {}
+        # centralized config database (ConfigMonitor role)
+        self.config_db: dict[str, dict[str, str]] = {}
 
     # -- commit cycle ------------------------------------------------------
     def commit(self, inc: Incremental) -> int:
@@ -354,6 +356,138 @@ def _cmd_osd_dump(mon: Monitor, cmd: dict) -> MMonCommandReply:
     )
 
 
+def _cmd_health(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """'ceph health' (HealthMonitor role): DOWN/OUT osds degrade."""
+    m = mon.osdmap
+    down = [o for o in range(m.max_osd) if m.exists(o) and not m.is_up(o)]
+    out = [
+        o for o in range(m.max_osd)
+        if m.exists(o) and m.osd_weight[o] == 0
+    ]
+    checks = []
+    if down:
+        checks.append(f"{len(down)} osds down")
+    if out:
+        checks.append(f"{len(out)} osds out")
+    status = "HEALTH_OK" if not checks else "HEALTH_WARN"
+    return MMonCommandReply(
+        outs=status,
+        outb=json.dumps({"status": status, "checks": checks}),
+    )
+
+
+def _cmd_osd_tree(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """'ceph osd tree' (CrushTreeDumper role): the crush hierarchy
+    with up/down + weight per device, shadow trees hidden."""
+    m = mon.osdmap
+    crush = m.crush
+    shadows = {
+        c for per in crush.class_bucket.values() for c in per.values()
+    }
+    lines = []
+
+    def walk(item: int, depth: int, weight: int) -> None:
+        indent = "    " * depth
+        if item >= 0:
+            state = "up" if m.is_up(item) else "down"
+            reweight = (
+                m.osd_weight[item] / 0x10000
+                if item < m.max_osd
+                else 0.0
+            )
+            cls = crush.class_names.get(
+                crush.class_map.get(item, -1), ""
+            )
+            lines.append(
+                f"{item:>4} {cls:>6} {weight / 0x10000:>8.5f} "
+                f"{indent}osd.{item} {state:>6} {reweight:.5f}"
+            )
+            return
+        b = crush.buckets[item]
+        name = crush.item_names.get(item, f"bucket{-1 - item}")
+        tname = crush.type_names.get(b.type, str(b.type))
+        lines.append(
+            f"{item:>4} {'':>6} {b.weight / 0x10000:>8.5f} "
+            f"{indent}{tname} {name}"
+        )
+        for child, w in zip(b.items, b.item_weights):
+            walk(child, depth + 1, w)
+
+    for root in sorted(crush._roots(), reverse=True):
+        if root in shadows:
+            continue
+        walk(root, 0, crush.buckets[root].weight)
+    header = "  ID  CLASS   WEIGHT NAME/STATE"
+    return MMonCommandReply(outb="\n".join([header] + lines))
+
+
+def _cmd_pg_dump(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """'ceph pg dump': every pool PG with its up/acting sets (the
+    OSDMonitor side of pg listing; per-PG I/O stats live on the mgr)."""
+    m = mon.osdmap
+    pgs = []
+    for pid, pool in m.pools.items():
+        for ps in range(pool.pg_num):
+            up, upp, acting, actingp = m.pg_to_up_acting_osds(pid, ps)
+            pgs.append(
+                {
+                    "pgid": f"{pid}.{ps}",
+                    "up": up,
+                    "up_primary": upp,
+                    "acting": acting,
+                    "acting_primary": actingp,
+                }
+            )
+    return MMonCommandReply(outb=json.dumps({"pg_stats": pgs}))
+
+
+def _cmd_pool_ls(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    names = [
+        mon.osdmap.pool_names.get(pid, str(pid))
+        for pid in sorted(mon.osdmap.pools)
+    ]
+    return MMonCommandReply(
+        outs="\n".join(names), outb=json.dumps(names)
+    )
+
+
+def _cmd_ec_profile_get(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    name = cmd["name"]
+    prof = mon.osdmap.erasure_code_profiles.get(name)
+    if prof is None:
+        return MMonCommandReply(rc=-2, outs=f"profile {name!r} not found")
+    return MMonCommandReply(outb=json.dumps(prof))
+
+
+def _cmd_ec_profile_ls(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    return MMonCommandReply(
+        outb=json.dumps(sorted(mon.osdmap.erasure_code_profiles))
+    )
+
+
+def _cmd_config_set(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """ConfigMonitor role: centralized config database ('ceph config
+    set <who> <key> <value>')."""
+    who, key, value = cmd["who"], cmd["key"], str(cmd["value"])
+    mon.config_db.setdefault(who, {})[key] = value
+    return MMonCommandReply(outs=f"set {who}/{key}")
+
+
+def _cmd_config_get(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    who = cmd["who"]
+    key = cmd.get("key")
+    section = mon.config_db.get(who, {})
+    if key is not None:
+        if key not in section:
+            return MMonCommandReply(rc=-2, outs=f"no config {who}/{key}")
+        return MMonCommandReply(outs=section[key], outb=json.dumps(section[key]))
+    return MMonCommandReply(outb=json.dumps(section))
+
+
+def _cmd_config_dump(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    return MMonCommandReply(outb=json.dumps(mon.config_db))
+
+
 _COMMANDS = {
     "status": _cmd_status,
     "osd down": _cmd_osd_down,
@@ -364,6 +498,15 @@ _COMMANDS = {
     "osd pool create": _cmd_pool_create,
     "osd pool delete": _cmd_pool_delete,
     "osd erasure-code-profile set": _cmd_ec_profile_set,
+    "osd erasure-code-profile get": _cmd_ec_profile_get,
+    "osd erasure-code-profile ls": _cmd_ec_profile_ls,
+    "osd tree": _cmd_osd_tree,
+    "osd pool ls": _cmd_pool_ls,
+    "pg dump": _cmd_pg_dump,
+    "health": _cmd_health,
+    "config set": _cmd_config_set,
+    "config get": _cmd_config_get,
+    "config dump": _cmd_config_dump,
 }
 
 

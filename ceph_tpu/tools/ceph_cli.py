@@ -1,0 +1,139 @@
+"""``ceph`` CLI — the admin command surface (src/ceph.in).
+
+The reference CLI translates argv into JSON command objects described
+by MonCommands.h and ships them to the monitor; replies carry a text
+``outs`` and a data ``outb``.  This CLI does exactly that over the
+framework's MMonCommand path:
+
+    python -m ceph_tpu.tools.ceph_cli -m HOST:PORT status
+    ... osd tree | osd dump | osd pool ls | pg dump | health
+    ... osd pool create NAME [PG_NUM] [--size N] [--pool-type N]
+    ... osd pool delete NAME
+    ... osd down/out/in ID | osd reweight ID WEIGHT
+    ... osd erasure-code-profile set NAME k=4 m=2 [...]
+    ... osd erasure-code-profile get NAME | ls
+    ... config set WHO KEY VALUE | config get WHO [KEY] | config dump
+
+``--format json`` prints outb; the default prints outs (or pretty
+outb when there is no outs), like the reference's -f handling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..mon.monitor import MonClient
+from ..msg import Messenger
+
+
+def _build_command(args: list[str]) -> dict:
+    """argv tail → JSON command (the MonCommands.h translation)."""
+    joined = " ".join(args)
+    # longest-prefix match over the known command table shapes
+    if joined.startswith("osd pool create"):
+        rest = args[3:]
+        cmd = {"prefix": "osd pool create", "pool": rest[0]}
+        if len(rest) > 1 and rest[1].isdigit():
+            cmd["pg_num"] = int(rest[1])
+        for kv in rest[1:]:
+            if "=" in kv:
+                k, _, v = kv.partition("=")
+                cmd[k.replace("-", "_")] = v
+        return cmd
+    if joined.startswith("osd pool delete"):
+        return {"prefix": "osd pool delete", "pool": args[3]}
+    if joined.startswith("osd pool ls"):
+        return {"prefix": "osd pool ls"}
+    if joined.startswith("osd erasure-code-profile set"):
+        profile = {}
+        for kv in args[4:]:
+            k, _, v = kv.partition("=")
+            profile[k] = v
+        return {
+            "prefix": "osd erasure-code-profile set",
+            "name": args[3],
+            "profile": profile,
+        }
+    if joined.startswith("osd erasure-code-profile get"):
+        return {"prefix": "osd erasure-code-profile get", "name": args[3]}
+    if joined.startswith("osd erasure-code-profile ls"):
+        return {"prefix": "osd erasure-code-profile ls"}
+    if joined.startswith(("osd down", "osd out", "osd in")):
+        return {"prefix": f"osd {args[1]}", "id": int(args[2])}
+    if joined.startswith("osd reweight"):
+        return {
+            "prefix": "osd reweight",
+            "id": int(args[2]),
+            "weight": float(args[3]),
+        }
+    if joined.startswith("osd tree"):
+        return {"prefix": "osd tree"}
+    if joined.startswith("osd dump"):
+        return {"prefix": "osd dump"}
+    if joined.startswith("pg dump"):
+        return {"prefix": "pg dump"}
+    if joined.startswith("config set"):
+        return {
+            "prefix": "config set",
+            "who": args[1],
+            "key": args[2],
+            "value": " ".join(args[3:]),
+        }
+    if joined.startswith("config get"):
+        cmd = {"prefix": "config get", "who": args[1]}
+        if len(args) > 2:
+            cmd["key"] = args[2]
+        return cmd
+    if joined.startswith("config dump"):
+        return {"prefix": "config dump"}
+    if args[0] in ("status", "health"):
+        return {"prefix": args[0]}
+    # pass-through: let the monitor reject unknowns (same as the
+    # reference's validation living mon-side)
+    return {"prefix": joined}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="ceph", description=__doc__, add_help=True
+    )
+    p.add_argument(
+        "-m", "--mon", required=True, metavar="HOST:PORT",
+        help="monitor address",
+    )
+    p.add_argument(
+        "-f", "--format", choices=["plain", "json"], default="plain"
+    )
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    host, _, port = args.mon.partition(":")
+
+    msgr = Messenger("ceph-cli")
+    try:
+        mc = MonClient(msgr, whoami=-1)
+        mc.connect(host, int(port))
+        reply = mc.command(_build_command(args.command))
+    finally:
+        msgr.shutdown()
+
+    if args.format == "json":
+        print(reply.outb or json.dumps({"status": reply.outs}))
+    else:
+        if reply.outs:
+            print(reply.outs)
+        if reply.outb and not reply.outs:
+            try:
+                print(json.dumps(json.loads(reply.outb), indent=2))
+            except (ValueError, TypeError):
+                print(reply.outb)
+    if reply.rc != 0 and not reply.outs:
+        print(f"Error: rc={reply.rc}", file=sys.stderr)
+    return 0 if reply.rc == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

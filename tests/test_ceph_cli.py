@@ -1,0 +1,94 @@
+"""ceph CLI + extended mon command surface tests (src/ceph.in,
+MonCommands.h): argv → JSON command translation and the new
+tree/health/pg-dump/config/profile commands against a live monitor."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from ceph_tpu.tools.ceph_cli import _build_command, main
+
+from test_osd_daemon import MiniCluster
+
+
+@pytest.fixture
+def mon():
+    c = MiniCluster()
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+def _run(capsys, mon, *words, fmt="json"):
+    rc = main(["-m", f"{mon.mon_addr[0]}:{mon.mon_addr[1]}",
+               "-f", fmt, *words])
+    out = capsys.readouterr().out
+    return rc, out
+
+
+def test_command_translation():
+    assert _build_command(["status"]) == {"prefix": "status"}
+    assert _build_command(["osd", "down", "3"]) == {
+        "prefix": "osd down", "id": 3,
+    }
+    cmd = _build_command(
+        ["osd", "pool", "create", "data", "8", "size=2"]
+    )
+    assert cmd == {
+        "prefix": "osd pool create", "pool": "data", "pg_num": 8,
+        "size": "2",
+    }
+    cmd = _build_command(
+        ["osd", "erasure-code-profile", "set", "p", "k=4", "m=2"]
+    )
+    assert cmd["name"] == "p" and cmd["profile"] == {"k": "4", "m": "2"}
+    assert _build_command(["config", "set", "osd", "debug", "5"]) == {
+        "prefix": "config set", "who": "osd", "key": "debug",
+        "value": "5",
+    }
+
+
+def test_cli_against_live_monitor(capsys, mon):
+    rc, out = _run(capsys, mon, "status")
+    assert rc == 0 and json.loads(out)["num_osds"] == 3
+
+    rc, out = _run(capsys, mon, "health")
+    assert rc == 0  # nothing booted: all exist but down → WARN
+    assert json.loads(out)["status"] in ("HEALTH_OK", "HEALTH_WARN")
+
+    rc, out = _run(capsys, mon, "osd", "pool", "create", "cli-pool",
+                   "4", "size=3")
+    assert rc == 0
+
+    rc, out = _run(capsys, mon, "osd", "pool", "ls")
+    assert "cli-pool" in json.loads(out)
+
+    rc, out = _run(capsys, mon, "pg", "dump")
+    stats = json.loads(out)["pg_stats"]
+    assert any(p["pgid"].endswith(".0") for p in stats)
+
+    rc, out = _run(capsys, mon, "osd", "tree", fmt="plain")
+    assert rc == 0 and "root" in out and "osd.0" in out
+
+    rc, out = _run(capsys, mon, "osd", "erasure-code-profile", "set",
+                   "cliprof", "k=4", "m=2", "plugin=jerasure")
+    assert rc == 0
+    rc, out = _run(capsys, mon, "osd", "erasure-code-profile", "get",
+                   "cliprof")
+    assert json.loads(out)["k"] == "4"
+    rc, out = _run(capsys, mon, "osd", "erasure-code-profile", "ls")
+    assert "cliprof" in json.loads(out)
+
+    rc, out = _run(capsys, mon, "config", "set", "osd",
+                   "debug_level", "5")
+    assert rc == 0
+    rc, out = _run(capsys, mon, "config", "get", "osd", "debug_level")
+    assert json.loads(out) == "5"
+    rc, out = _run(capsys, mon, "config", "dump")
+    assert json.loads(out)["osd"]["debug_level"] == "5"
+
+    rc, out = _run(capsys, mon, "bogus", "command")
+    assert rc != 0
