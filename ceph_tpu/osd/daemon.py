@@ -868,6 +868,13 @@ class OSD(Dispatcher):
                 self._persist_entry(pg, entry)
         pg.info = _decode_info(msg.info_blob)
         pg.info.last_update = pg.log.head
+        # the primary encodes info_blob before bumping its own
+        # last_epoch_started; activation IS the epoch start, so stamp
+        # it here too or replicas carry a stale les forever and
+        # find_best_info's les-first ordering compares garbage
+        pg.info.last_epoch_started = max(
+            pg.info.last_epoch_started, msg.epoch
+        )
         pg.seq = max(pg.seq, pg.info.last_update[1])
         pg.state = "replica"
         pg.activated_epoch = msg.epoch

@@ -3,7 +3,8 @@
 
 Every client op on a PG appends one entry (MODIFY or DELETE of an
 object at an eversion).  Peering compares logs: the authoritative log
-is the one with the newest ``last_update`` (find_best_info), and a
+is chosen by greatest ``last_epoch_started`` then newest
+``last_update`` (find_best_info), and a
 peer's missing set is exactly the objects named by authoritative
 entries newer than that peer's ``last_update`` (proc_replica_log /
 PGLog::merge_log's missing accumulation).  A peer whose last_update
@@ -139,8 +140,10 @@ class PGLog:
 
 def find_best_info(infos: dict[int, PGInfo]) -> int | None:
     """Authoritative peer choice (PeeringState::find_best_info):
-    newest last_update, then longest log (smallest tail), then lowest
-    osd id for determinism.  None when no peer has any history."""
+    greatest last_epoch_started first (a peer from a stale interval
+    must never win on a higher last_update alone), then newest
+    last_update, then longest log (smallest tail), then lowest osd id
+    for determinism.  None when no peer has any history."""
     best = None
     for osd, info in sorted(infos.items()):
         if info.last_update == EV_ZERO and info.last_epoch_started == 0:
@@ -149,11 +152,11 @@ def find_best_info(infos: dict[int, PGInfo]) -> int | None:
             best = osd
             continue
         cur = infos[best]
-        if (info.last_update, ) > (cur.last_update, ):
+        key = (info.last_epoch_started, info.last_update)
+        cur_key = (cur.last_epoch_started, cur.last_update)
+        if key > cur_key:
             best = osd
-        elif info.last_update == cur.last_update and (
-            info.log_tail < cur.log_tail
-        ):
+        elif key == cur_key and info.log_tail < cur.log_tail:
             best = osd
     return best
 

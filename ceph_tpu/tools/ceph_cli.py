@@ -47,14 +47,12 @@ def _build_command(args: list[str]) -> dict:
     if joined.startswith("osd pool ls"):
         return {"prefix": "osd pool ls"}
     if joined.startswith("osd erasure-code-profile set"):
-        profile = {}
-        for kv in args[4:]:
-            k, _, v = kv.partition("=")
-            profile[k] = v
+        # monitor-side _cmd_ec_profile_set expects the raw list of
+        # "k=v" strings (the MonCommands.h CephString[] shape)
         return {
             "prefix": "osd erasure-code-profile set",
             "name": args[3],
-            "profile": profile,
+            "profile": list(args[4:]),
         }
     if joined.startswith("osd erasure-code-profile get"):
         return {"prefix": "osd erasure-code-profile get", "name": args[3]}
@@ -77,14 +75,14 @@ def _build_command(args: list[str]) -> dict:
     if joined.startswith("config set"):
         return {
             "prefix": "config set",
-            "who": args[1],
-            "key": args[2],
-            "value": " ".join(args[3:]),
+            "who": args[2],
+            "key": args[3],
+            "value": " ".join(args[4:]),
         }
     if joined.startswith("config get"):
-        cmd = {"prefix": "config get", "who": args[1]}
-        if len(args) > 2:
-            cmd["key"] = args[2]
+        cmd = {"prefix": "config get", "who": args[2]}
+        if len(args) > 3:
+            cmd["key"] = args[3]
         return cmd
     if joined.startswith("config dump"):
         return {"prefix": "config dump"}

@@ -44,7 +44,9 @@ def test_command_translation():
     cmd = _build_command(
         ["osd", "erasure-code-profile", "set", "p", "k=4", "m=2"]
     )
-    assert cmd["name"] == "p" and cmd["profile"] == {"k": "4", "m": "2"}
+    # profile rides as the raw "k=v" string list (the MonCommands.h
+    # CephString[] shape the monitor-side handler parses)
+    assert cmd["name"] == "p" and cmd["profile"] == ["k=4", "m=2"]
     assert _build_command(["config", "set", "osd", "debug", "5"]) == {
         "prefix": "config set", "who": "osd", "key": "debug",
         "value": "5",

@@ -63,6 +63,16 @@ def test_find_best_info_ordering():
     assert find_best_info(infos) == 2
     # empty infos are ignored; all-empty -> None
     assert find_best_info({3: PGInfo()}) is None
+    # last_epoch_started dominates last_update: a peer from a stale
+    # interval must not win on a higher last_update alone
+    # (PeeringState::find_best_info's primary criterion)
+    stale = {
+        0: PGInfo(last_update=(2, 5), log_tail=(1, 1),
+                  last_epoch_started=3),
+        1: PGInfo(last_update=(4, 9), log_tail=(1, 1),
+                  last_epoch_started=1),
+    }
+    assert find_best_info(stale) == 0
 
 
 def test_needs_backfill():
