@@ -37,6 +37,7 @@ from ..msg.message import (
     MOSDFailure,
 )
 from ..msg.messenger import Connection, Dispatcher
+from ..crush.types import PG_POOL_TYPE_ERASURE
 from ..osd.failure import FailureAggregator
 from ..osd.osdmap import Incremental, OSDMap, PgPool
 from ..store.objectstore import MemStore, ObjectStore, StoreError, Transaction
@@ -280,19 +281,79 @@ def _cmd_osd_reweight(mon: Monitor, cmd: dict) -> MMonCommandReply:
 
 
 def _cmd_pool_create(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """Pool creation (OSDMonitor "osd pool create").  Erasure pools
+    (pool_type=3) size themselves from the profile (size=k+m,
+    min_size=k+1 — OSDMonitor::prepare_pool_size) and, when no
+    crush_rule is given, get a profile-named indep rule created the
+    way the plugin's create_rule would (OSDMonitor.cc:10928 flow)."""
     name = cmd["pool"]
     if name in mon.osdmap.pool_names.values():
         return MMonCommandReply(rc=-17, outs=f"pool {name!r} exists")
     pool_id = mon.osdmap.pool_max + 1
+    ptype = int(cmd.get("pool_type", 1))
+    size = int(cmd.get("size", 3))
+    min_size = cmd.get("min_size")
+    crush_rule = cmd.get("crush_rule")
+    profile_name = cmd.get("erasure_code_profile", "")
+    inc = mon.pending()
+    if ptype == PG_POOL_TYPE_ERASURE:
+        profile_name = profile_name or "default"
+        profile = mon.osdmap.erasure_code_profiles.get(profile_name)
+        if profile is None:
+            return MMonCommandReply(
+                rc=-2,
+                outs=f"erasure-code-profile {profile_name!r} not found",
+            )
+        try:
+            from ..osd.ec_pg import ECCodec
+
+            codec = ECCodec(profile)
+        except Exception as e:  # noqa: BLE001 — profile is user input
+            return MMonCommandReply(
+                rc=-22, outs=f"invalid profile {profile_name!r}: {e}"
+            )
+        size = codec.n
+        min_size = (
+            int(min_size) if min_size is not None else codec.k + 1
+        )
+        if crush_rule is None:
+            # reuse a rule already named after the profile, else build
+            # one on a crushmap copy and ship it in the incremental
+            cmap = mon.osdmap.crush
+            existing = [
+                rid
+                for rid, rname in cmap.rule_names.items()
+                if rname == profile_name
+            ]
+            if existing:
+                crush_rule = existing[0]
+            else:
+                import copy as _copy
+
+                newmap = _copy.deepcopy(cmap)
+                try:
+                    crush_rule = newmap.add_simple_rule(
+                        profile_name,
+                        profile.get("crush-root", "default"),
+                        profile.get("crush-failure-domain", "host"),
+                        mode="indep",
+                    )
+                except (KeyError, AssertionError) as e:
+                    return MMonCommandReply(
+                        rc=-22,
+                        outs=f"cannot create erasure rule: {e}",
+                    )
+                inc.crush = newmap
     pool = PgPool(
         pool_id=pool_id,
-        type=int(cmd.get("pool_type", 1)),
-        size=int(cmd.get("size", 3)),
+        type=ptype,
+        size=size,
         pg_num=int(cmd.get("pg_num", 32)),
-        crush_rule=int(cmd.get("crush_rule", 0)),
-        erasure_code_profile=cmd.get("erasure_code_profile", ""),
+        crush_rule=int(crush_rule or 0),
+        erasure_code_profile=profile_name,
     )
-    inc = mon.pending()
+    if min_size is not None:
+        pool.min_size = int(min_size)
     inc.new_pools[pool_id] = pool
     inc.new_pool_names[pool_id] = name
     inc.new_pool_max = pool_id

@@ -179,6 +179,7 @@ READ_ATTR = 1  # (cid, oid, attr) -> bytes
 READ_STAT = 2  # (cid, oid) -> size
 READ_EXISTS = 3  # (cid, oid) -> bool
 READ_LIST = 4  # (cid,) -> [oid]
+READ_ATTRS = 5  # (cid, oid) -> encoded {name: value} map
 
 
 @register_message
@@ -641,16 +642,24 @@ class MPGActivate(Message):
 class MPGPull(Message):
     """Recovering primary → authoritative peer: send me this object
     (the pull side of recovery, ReplicatedBackend::prepare_pull);
-    answered by a tid-paired MPGPush."""
+    answered by a tid-paired MPGPush.  For erasure pools ``shard`` is
+    the requester's acting-set position — the server reconstructs that
+    shard's bytes (ECBackend recovery reads); -1 = whole object
+    (replicated pools)."""
 
     TYPE = 23
     pgid: str = ""
     epoch: int = 0
     oid: str = ""
+    shard: int = -1
 
     def encode_payload(self, e: Encoder) -> None:
         e.string(self.pgid).u32(self.epoch).string(self.oid)
+        e.s32(self.shard)
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MPGPull":
-        return cls(pgid=d.string(), epoch=d.u32(), oid=d.string())
+        return cls(
+            pgid=d.string(), epoch=d.u32(), oid=d.string(),
+            shard=d.s32(),
+        )

@@ -33,19 +33,35 @@ Shape vs the reference:
   primaries re-peer (OSD.cc:5235 handle_osd_ping / :5889
   send_failures).
 
-Replicated pools run fully through this daemon.  Erasure pools keep
-the dedicated shard data plane (store/remote.py) — wiring ECStore
-under PG peering is tracked in docs/PARITY.md.
+Both pool types run through this one daemon — ONE peering/pg_log/
+failover/recovery machinery with two backends, the reference's
+build_pg_backend split (src/osd/PGBackend.cc:571-607):
+
+- Replicated pools ship the SAME transaction to every acting OSD.
+- Erasure pools (osd/ec_pg.py) encode the object and ship a DIFFERENT
+  per-position transaction (shard bytes + HashInfo + log entry + info)
+  down the same MOSDRepOp path (ECBackend::submit_transaction under
+  PrimaryLogPG, ECBackend.cc:1502).  Reads and recovery mount the
+  ECStore machinery over RemoteStore proxies so reconstruction and
+  minimum-repair (CLAY fractional) reads travel as MECSubRead sub-ops
+  (handle_sub_read, ECBackend.cc:1010); recovery pushes carry
+  reconstructed shard bytes (objects_read_and_reconstruct,
+  ECBackend.cc:2364).
 """
 
 from __future__ import annotations
 
+import json
 import queue
 import threading
 import time
 
 from ..common.encoding import Decoder, Encoder
+from ..crush.types import CRUSH_ITEM_NONE
+from ..ec.interface import ErasureCodeError
 from ..msg import (
+    MECSubRead,
+    MECSubWrite,
     Message,
     MessageError,
     Messenger,
@@ -78,7 +94,10 @@ from ..msg.message import (
 from ..msg.messenger import Connection, Dispatcher
 from ..cls import RD as CLS_RD, WR as CLS_WR, ClassError, MethodContext, default_handler
 from ..mon.monitor import MonClient
+from ..store.ec_store import ECStore, HINFO_KEY
 from ..store.objectstore import MemStore, ObjectStore, StoreError, Transaction
+from ..store.remote import RemoteStore, ShardServer
+from .ec_pg import ECCodec, UnreachableStore, shard_write_txn
 from .failure import HeartbeatTracker
 from .pg_log import (
     DELETE,
@@ -144,6 +163,9 @@ class PG:
         # pg log dups role): outlives trimmed entries so a late retry
         # still dedups AND replays its original result
         self.reqid_cache: dict[str, tuple] = {}
+        # erasure pools: cached (key, ECStore, conns) view over the
+        # acting set; rebuilt when the interval/up-set/conns change
+        self.ec_view: tuple | None = None
 
 
 class OSD(Dispatcher):
@@ -171,6 +193,11 @@ class OSD(Dispatcher):
         self._conn_lock = threading.Lock()
         self.hb = HeartbeatTracker(whoami, grace=heartbeat_grace)
         self.tick_interval = tick_interval
+        # EC pool support: cached codecs per profile + a shard-serving
+        # delegate answering MECSubRead/MECSubWrite from our store
+        # (the handle_sub_read/handle_sub_write role)
+        self._ec_codecs: dict[tuple, ECCodec] = {}
+        self._shard_server = ShardServer(self.store, whoami)
         self.log_keep = 128  # pg_log length bound (osd_min_pg_log_entries role)
         self.class_handler = default_handler  # ClassHandler role
         self.addr: tuple[str, int] | None = None
@@ -264,8 +291,6 @@ class OSD(Dispatcher):
                 self._reported.discard(osd)
             self._last_up[osd] = up
         for pool_id, pool in osdmap.pools.items():
-            if not pool.can_shift_osds():
-                continue  # EC pools use the shard data plane
             for ps in range(pool.pg_num):
                 up, _upp, acting, primary = osdmap.pg_to_up_acting_osds(
                     pool_id, ps
@@ -285,10 +310,14 @@ class OSD(Dispatcher):
                 if primary == self.whoami:
                     # re-peer only on interval change (the reference's
                     # new-interval test) — an unrelated epoch bump must
-                    # not trigger a cluster-wide RPC storm
+                    # not trigger a cluster-wide RPC storm.  A pass
+                    # with failed recovery pushes leaves the interval
+                    # unpeered so the tick loop retries it.
                     if changed or pg.state != "active":
-                        self._peer(pg, epoch)
-                        pg.peered_interval = interval
+                        if self._peer(pg, epoch):
+                            pg.peered_interval = interval
+                        else:
+                            pg.peered_interval = None
                 else:
                     if changed:
                         # new interval: wait for the primary's
@@ -305,11 +334,94 @@ class OSD(Dispatcher):
         except StoreError:
             pass
 
+    # -- erasure-pool backend (osd/ec_pg.py) --------------------------------
+    def _pool_of(self, pg: PG):
+        return self.monc.osdmap.pools.get(pg.pool_id)
+
+    def _is_ec(self, pg: PG) -> bool:
+        pool = self._pool_of(pg)
+        return pool is not None and not pool.can_shift_osds()
+
+    def _ec_codec(self, pg: PG) -> ECCodec:
+        """The pool's codec, cached per profile contents
+        (the registry factory hop of PGBackend.cc:588)."""
+        pool = self._pool_of(pg)
+        profile = self.monc.osdmap.erasure_code_profiles.get(
+            pool.erasure_code_profile
+        )
+        if profile is None:
+            raise StoreError(
+                f"pool {pg.pool_id}: erasure profile "
+                f"{pool.erasure_code_profile!r} missing (-EINVAL)"
+            )
+        key = tuple(sorted(profile.items()))
+        codec = self._ec_codecs.get(key)
+        if codec is None:
+            codec = self._ec_codecs[key] = ECCodec(profile)
+        return codec
+
+    def _ec_store_for(self, pg: PG) -> ECStore:
+        """Mount the EC machinery over the acting set: my position is
+        my own store, live peers are RemoteStore proxies (MECSubRead
+        sub-op reads), holes/down peers raise like dead shards."""
+        codec = self._ec_codec(pg)
+        if len(pg.acting) != codec.n:
+            raise StoreError(
+                f"pg {pg.pgid}: acting size {len(pg.acting)} != "
+                f"k+m={codec.n} (-EAGAIN)"
+            )
+        osdmap = self.monc.osdmap
+        key = (
+            tuple(pg.acting),
+            tuple(
+                o != CRUSH_ITEM_NONE and osdmap.is_up(o)
+                for o in pg.acting
+            ),
+        )
+        cached = pg.ec_view
+        if (
+            cached is not None
+            and cached[0] == key
+            and all(not c._closed for c in cached[2])
+        ):
+            return cached[1]
+        stores: list[ObjectStore] = []
+        conns: list[Connection] = []
+        for osd in pg.acting:
+            if osd == self.whoami:
+                stores.append(self.store)
+            elif osd == CRUSH_ITEM_NONE or not osdmap.is_up(osd):
+                stores.append(UnreachableStore())
+            else:
+                try:
+                    conn = self._peer_conn(osd)
+                except (MessageError, OSError):
+                    stores.append(UnreachableStore())
+                    continue
+                conns.append(conn)
+                stores.append(RemoteStore(conn, timeout=15.0))
+        ecs = ECStore(
+            ec=codec.ec,
+            stores=stores,
+            cid=pg.cid,
+            stripe_width=codec.sinfo.stripe_width,
+            ensure_collections=False,
+        )
+        pg.ec_view = (key, ecs, conns)
+        return ecs
+
     # -- peering (primary) -------------------------------------------------
-    def _peer(self, pg: PG, epoch: int) -> None:
-        """GetInfo → GetLog → GetMissing → Active in one worker pass."""
+    def _peer(self, pg: PG, epoch: int) -> bool:
+        """GetInfo → GetLog → GetMissing → Active in one worker pass.
+        Returns False when some peer's recovery could not complete —
+        the caller must leave the interval unpeered so the tick loop
+        retries (a skipped push would otherwise become a permanent
+        shard hole once activation advances the peer's log)."""
         pg.state = "peering"
-        peers = [o for o in pg.acting if o != self.whoami]
+        peers = [
+            o for o in pg.acting
+            if o != self.whoami and o != CRUSH_ITEM_NONE
+        ]
         infos: dict[int, PGInfo] = {self.whoami: pg.info}
         peer_logs: dict[int, list[LogEntry]] = {}
         reachable: list[int] = []
@@ -336,16 +448,19 @@ class OSD(Dispatcher):
 
         # primary consistent: rewind+push what each reachable peer
         # misses, then activate everyone
+        all_ok = True
         for osd in reachable:
             peer_info = infos.get(osd, PGInfo(pgid=pg.pgid))
             rewind = self._divergence_point(
                 pg, peer_info, peer_logs.get(osd, [])
             )
-            self._recover_peer(pg, epoch, osd, peer_info, rewind)
+            if not self._recover_peer(pg, epoch, osd, peer_info, rewind):
+                all_ok = False
         pg.state = "active"
         pg.activated_epoch = epoch
         pg.info.last_epoch_started = epoch
         self._persist_info(pg)
+        return all_ok
 
     def _divergence_point(
         self, pg: PG, peer_info: PGInfo, peer_entries: list[LogEntry]
@@ -405,9 +520,16 @@ class OSD(Dispatcher):
             except StoreError:
                 pass
             return
+        shard = -1
+        if self._is_ec(pg):
+            if self.whoami not in pg.acting:
+                return  # stray: nothing to hold here
+            shard = pg.acting.index(self.whoami)
         try:
             reply = self._peer_conn(source).call(
-                MPGPull(pgid=pg.pgid, epoch=epoch, oid=oid)
+                MPGPull(
+                    pgid=pg.pgid, epoch=epoch, oid=oid, shard=shard
+                )
             )
         except (MessageError, OSError):
             return
@@ -431,10 +553,13 @@ class OSD(Dispatcher):
     def _recover_peer(
         self, pg, epoch, osd, peer_info: PGInfo,
         rewind: tuple[int, int],
-    ) -> None:
+    ) -> bool:
         """Push the peer's missing objects (since its divergence
         point), then activate it: the peer rewinds past ``rewind``
-        and adopts the authoritative suffix."""
+        and adopts the authoritative suffix.  Returns False (and skips
+        the activation) when any push failed — activating anyway would
+        advance the peer's log past an object it never received,
+        making the hole invisible to every later peering pass."""
         since = rewind
         if needs_backfill(pg.info, peer_info) or since < pg.log.log_tail:
             since = pg.log.log_tail
@@ -442,12 +567,22 @@ class OSD(Dispatcher):
         try:
             conn = self._peer_conn(osd)
         except (MessageError, OSError):
-            return
+            return False
+        is_ec = self._is_ec(pg)
         for oid, version in missing.items():
             try:
-                conn.call(self._push_for(pg, epoch, oid))
+                if is_ec:
+                    pos = pg.acting.index(osd)
+                    push = self._ec_push_for(pg, epoch, oid, pos)
+                else:
+                    push = self._push_for(pg, epoch, oid)
+                conn.call(push)
             except (MessageError, OSError):
-                return
+                return False
+            except (StoreError, ErasureCodeError):
+                # not enough shards to reconstruct right now — leave
+                # this peer unactivated; the tick loop re-peers
+                return False
         suffix = [
             _encode_entry(e) for e in pg.log.entries_after(since)
         ]
@@ -468,6 +603,7 @@ class OSD(Dispatcher):
             )
         except (MessageError, OSError):
             pass
+        return True
 
     def _push_for(self, pg: PG, epoch: int, oid: str) -> MPGPush:
         """One object's recovery push, attrs included (prep_push)."""
@@ -486,6 +622,69 @@ class OSD(Dispatcher):
             exists=exists, data=data, attrs=attrs,
             entry_blob=_encode_entry(entry) if entry else b"",
         )
+
+    def _ec_push_for(
+        self, pg: PG, epoch: int, oid: str, pos: int
+    ) -> MPGPush:
+        """Recovery push for an erasure pool: RECONSTRUCT position
+        ``pos``'s shard from the minimum helper set (CLAY profiles read
+        fractional chunks) and ship it with its HashInfo + user/class
+        attrs (ECBackend RecoveryOp READING→WRITING with
+        minimum_to_decode reads, ECBackend.cc:1630)."""
+        entry = pg.log.object_op(oid)
+        store_oid = OBJ_PREFIX + oid
+        push = MPGPush(
+            pgid=pg.pgid, epoch=epoch, oid=oid, exists=False,
+            entry_blob=_encode_entry(entry) if entry else b"",
+        )
+        if entry is not None and entry.op == DELETE:
+            return push
+        # pin the authoritative HashInfo from our own shard when we
+        # hold it — a rewinding peer may still expose stale hinfo
+        meta = None
+        try:
+            meta = json.loads(
+                self.store.getattr(pg.cid, store_oid, HINFO_KEY)
+            )
+        except StoreError:
+            pass
+        ecs = self._ec_store_for(pg)
+        try:
+            data, _reads, meta = ecs.reconstruct_shard(
+                store_oid, pos, meta
+            )
+        except ErasureCodeError:
+            if meta is None and not self.store.exists(pg.cid, store_oid):
+                # object gone everywhere (e.g. a logged CALL removal)
+                return push
+            raise
+        attrs = {HINFO_KEY: json.dumps(meta).encode()}
+        # user/class attrs replicate on every shard — take them from
+        # our copy, or any reachable shard when ours is gone
+        src_attrs = None
+        if self.store.exists(pg.cid, store_oid):
+            src_attrs = self.store.list_attrs(pg.cid, store_oid)
+        else:
+            for i, st in enumerate(ecs.stores):
+                if i == pos:
+                    continue
+                try:
+                    src_attrs = st.list_attrs(pg.cid, store_oid)
+                    break
+                except StoreError:
+                    continue
+        if src_attrs:
+            attrs.update(
+                {
+                    k: v
+                    for k, v in src_attrs.items()
+                    if k.startswith(("u_", "c_"))
+                }
+            )
+        push.exists = True
+        push.data = data
+        push.attrs = attrs
+        return push
 
     # -- persistence -------------------------------------------------------
     def _persist_entry(self, pg: PG, entry: LogEntry, txn=None) -> None:
@@ -521,13 +720,26 @@ class OSD(Dispatcher):
             conn.send(reply)
             return
         store_oid = OBJ_PREFIX + msg.oid
+        is_ec = self._is_ec(pg)
         try:
             if msg.op == OSD_OP_READ:
-                reply.data = self.store.read(
-                    pg.cid, store_oid, msg.offset, msg.length
-                )
+                if is_ec:
+                    whole = self._ec_store_for(pg).get(store_oid)
+                    if msg.length < 0:
+                        reply.data = whole[msg.offset :]
+                    else:
+                        reply.data = whole[
+                            msg.offset : msg.offset + msg.length
+                        ]
+                else:
+                    reply.data = self.store.read(
+                        pg.cid, store_oid, msg.offset, msg.length
+                    )
             elif msg.op == OSD_OP_STAT:
-                reply.size = self.store.stat(pg.cid, store_oid)
+                if is_ec:
+                    reply.size = self._ec_store_for(pg).size(store_oid)
+                else:
+                    reply.size = self.store.stat(pg.cid, store_oid)
             elif msg.op == OSD_OP_GETXATTR:
                 reply.data = self.store.getattr(
                     pg.cid, store_oid, "u_" + msg.attr
@@ -550,7 +762,7 @@ class OSD(Dispatcher):
                 )
             else:
                 self._mutate(pg, epoch, msg, store_oid)
-        except (StoreError, ClassError) as e:
+        except (StoreError, ClassError, ErasureCodeError) as e:
             reply.ok = False
             reply.error = str(e)
         conn.send(reply)
@@ -580,6 +792,15 @@ class OSD(Dispatcher):
                 ).items()
                 if k.startswith("c_")
             }
+        if self._is_ec(pg):
+            # class attrs replicate on every shard, so the local read
+            # above stands; the DATA read must decode across shards
+            ecs = self._ec_store_for(pg)
+            return MethodContext(
+                read_fn=lambda: ecs.get(store_oid),
+                attrs=attrs,
+                exists=exists,
+            )
         return MethodContext(
             read_fn=lambda: self.store.read(pg.cid, store_oid),
             attrs=attrs,
@@ -591,6 +812,8 @@ class OSD(Dispatcher):
         same transaction to the acting peers (issue_repop).  Raises
         StoreError to surface op errors; replica failures surface as
         -EAGAIN so the client retries after the interval changes."""
+        if self._is_ec(pg):
+            return self._mutate_ec(pg, epoch, msg, store_oid)
         if msg.reqid and msg.reqid in pg.reqid_cache:
             # retried op already applied (osd_reqid_t dedup; the cache
             # outlives log trimming, like the log's dups) — replay the
@@ -677,15 +900,41 @@ class OSD(Dispatcher):
                     txn.setattr(pg.cid, store_oid, "c_" + k, v)
         elif msg.op == OSD_OP_DELETE:
             txn.remove(pg.cid, store_oid)
-        self._persist_entry(pg, entry, txn)
+        txn_by_osd = {
+            osd: txn
+            for osd in pg.acting
+            if osd != CRUSH_ITEM_NONE
+        }
+        return self._commit_and_replicate(
+            pg, epoch, msg, entry, txn_by_osd, outdata
+        )
+
+    def _commit_and_replicate(
+        self,
+        pg: PG,
+        epoch: int,
+        msg: MOSDOp,
+        entry: LogEntry,
+        txn_by_osd: dict[int, "Transaction"],
+        outdata: bytes,
+    ):
+        """Shared commit tail for both backends (issue_repop): stamp
+        the log entry + advanced info into every transaction, apply
+        our own with rollback-on-failure, dedup-cache, fan the rest
+        out as MOSDRepOp, and surface live replica failures as
+        -EAGAIN.  Replicated pools pass ONE shared Transaction for all
+        targets; erasure pools pass a distinct per-position one."""
+        version = entry.version
         # advance pg.info inside the txn, but only adopt it in memory
         # once the local apply succeeded — a failed transaction must
         # not leave a phantom entry in the in-memory log
         saved_last = pg.info.last_update
         pg.info.last_update = version
-        self._persist_info(pg, txn)
+        for txn in {id(t): t for t in txn_by_osd.values()}.values():
+            self._persist_entry(pg, entry, txn)
+            self._persist_info(pg, txn)
         try:
-            self.store.queue_transaction(txn)
+            self.store.queue_transaction(txn_by_osd[self.whoami])
         except StoreError:
             pg.info.last_update = saved_last
             pg.seq -= 1
@@ -697,7 +946,7 @@ class OSD(Dispatcher):
                 pg.reqid_cache.pop(next(iter(pg.reqid_cache)))
         entry_blob = _encode_entry(entry)
         failed: list[int] = []
-        for osd in pg.acting:
+        for osd, txn in txn_by_osd.items():
             if osd == self.whoami:
                 continue
             try:
@@ -729,6 +978,145 @@ class OSD(Dispatcher):
             )
         self._maybe_trim(pg)
         return outdata
+
+    def _mutate_ec(self, pg: PG, epoch: int, msg: MOSDOp, store_oid: str):
+        """Erasure-pool mutation: encode the new logical object and fan
+        one per-position transaction (shard + HashInfo + log entry +
+        info) down the same MOSDRepOp path replicated pools use
+        (ECBackend::submit_transaction under PrimaryLogPG,
+        ECBackend.cc:1502).  Partial writes read-modify-write the whole
+        object through the reconstructing read path — the daemon's
+        simplification of the stripe-granular RMW pipeline that
+        store/ec_store.py keeps."""
+        if msg.reqid and msg.reqid in pg.reqid_cache:
+            return pg.reqid_cache[msg.reqid][1]
+        osdmap = self.monc.osdmap
+        pool = self._pool_of(pg)
+        codec = self._ec_codec(pg)
+        ecs = self._ec_store_for(pg)
+        present = [
+            (pos, osd)
+            for pos, osd in enumerate(pg.acting)
+            if osd != CRUSH_ITEM_NONE
+            and (osd == self.whoami or osdmap.is_up(osd))
+        ]
+        if len(present) < max(codec.k, pool.min_size):
+            # the reference refuses writes below min_size (undersized)
+            raise StoreError(
+                f"pg {pg.pgid} undersized: {len(present)} shards < "
+                f"min_size {max(codec.k, pool.min_size)} (-EAGAIN)"
+            )
+        try:
+            old_meta = ecs.meta(store_oid)
+        except ErasureCodeError:
+            old_meta = None
+        existed = old_meta is not None
+        if msg.op == OSD_OP_DELETE and not existed:
+            raise StoreError(f"no object {msg.oid} (-ENOENT)")
+        ctx = None
+        outdata = b""
+        if msg.op == OSD_OP_CALL:
+            # method runs BEFORE any state advances (failure must
+            # leave no trace), same contract as the replicated path
+            cls_name, _, method = msg.attr.partition(".")
+            ctx = self._cls_ctx(pg, store_oid)
+            outdata = self._cls_call(cls_name, method, ctx, msg.data)
+
+        def read_old() -> bytes:
+            try:
+                return ecs.get(store_oid) if existed else b""
+            except ErasureCodeError as e:
+                raise StoreError(str(e))
+
+        txns: dict[int, Transaction] = {}
+
+        def encode_all(new_data: bytes, extra_attrs=None) -> None:
+            shards, meta = codec.encode_object(new_data)
+            for pos, _osd in present:
+                txns[pos] = shard_write_txn(
+                    pg.cid, store_oid, shards[pos], meta, extra_attrs
+                )
+
+        def remove_all() -> None:
+            for pos, _osd in present:
+                # touch-then-remove applies cleanly whether or not the
+                # replica holds the object (a lagging shard must still
+                # accept the logged removal)
+                txns[pos] = (
+                    Transaction()
+                    .touch(pg.cid, store_oid)
+                    .remove(pg.cid, store_oid)
+                )
+
+        if msg.op == OSD_OP_WRITEFULL:
+            encode_all(msg.data)
+        elif msg.op == OSD_OP_APPEND:
+            encode_all(read_old() + msg.data)
+        elif msg.op == OSD_OP_WRITE:
+            old = read_old()
+            end = msg.offset + len(msg.data)
+            buf = bytearray(max(len(old), end))
+            buf[: len(old)] = old
+            buf[msg.offset : end] = msg.data
+            encode_all(bytes(buf))
+        elif msg.op == OSD_OP_SETXATTR:
+            if existed:
+                # touch first: the txn must apply unconditionally on a
+                # lagging shard that does not hold the object yet
+                for pos, _osd in present:
+                    txns[pos] = (
+                        Transaction()
+                        .touch(pg.cid, store_oid)
+                        .setattr(
+                            pg.cid, store_oid, "u_" + msg.attr,
+                            msg.data,
+                        )
+                    )
+            else:
+                encode_all(b"", {"u_" + msg.attr: msg.data})
+        elif msg.op == OSD_OP_DELETE:
+            remove_all()
+        elif msg.op == OSD_OP_CALL:
+            if ctx.removed:
+                if existed:
+                    remove_all()
+            else:
+                new_attrs = {
+                    "c_" + k: v for k, v in ctx.new_attrs.items()
+                }
+                if ctx.new_data is not None:
+                    # shard rewrites truncate in place, so the object's
+                    # other attrs survive (cls_cxx_write_full keeps them)
+                    encode_all(ctx.new_data, new_attrs)
+                elif new_attrs and existed:
+                    for pos, _osd in present:
+                        txn = Transaction().touch(pg.cid, store_oid)
+                        for k, v in new_attrs.items():
+                            txn.setattr(pg.cid, store_oid, k, v)
+                        txns[pos] = txn
+                elif not existed:
+                    encode_all(b"", new_attrs)
+        else:
+            raise StoreError(f"op {msg.op} unsupported on EC (-EOPNOTSUPP)")
+
+        pg.seq += 1
+        version = (epoch, pg.seq)
+        op = DELETE if msg.op == OSD_OP_DELETE else MODIFY
+        prior = pg.log.object_op(msg.oid)
+        entry = LogEntry(
+            op=op, oid=msg.oid, version=version, reqid=msg.reqid,
+            prior_version=(
+                prior.version if prior is not None
+                else ((1, 0) if existed else EV_ZERO)
+            ),
+        )
+        txn_by_osd = {
+            osd: txns.setdefault(pos, Transaction())
+            for pos, osd in present
+        }
+        return self._commit_and_replicate(
+            pg, epoch, msg, entry, txn_by_osd, outdata
+        )
 
     def _maybe_trim(self, pg: PG) -> None:
         """Bound the pg log (PGLog::trim), removing the trimmed
@@ -803,6 +1191,24 @@ class OSD(Dispatcher):
             push = MPGPush(
                 tid=msg.tid, pgid=msg.pgid, oid=msg.oid, exists=False
             )
+        elif msg.shard >= 0:
+            # erasure pull: reconstruct the requester's shard (runs on
+            # the worker — the gather is nested sub-op RPC)
+            try:
+                push = self._ec_push_for(
+                    pg, msg.epoch, msg.oid, msg.shard
+                )
+            except (StoreError, ErasureCodeError, MessageError, OSError):
+                push = MPGPush(
+                    tid=msg.tid, pgid=msg.pgid, oid=msg.oid,
+                    exists=False,
+                )
+            push.tid = msg.tid
+        elif self._is_ec(pg):
+            # whole-object pulls are meaningless on an erasure pool
+            push = MPGPush(
+                tid=msg.tid, pgid=msg.pgid, oid=msg.oid, exists=False
+            )
         else:
             push = self._push_for(pg, msg.epoch, msg.oid)
             push.tid = msg.tid
@@ -820,13 +1226,14 @@ class OSD(Dispatcher):
             return pg
 
     def _handle_push(self, conn: Connection, msg: MPGPush) -> None:
+        """Recovery push: apply the object DATA only.  The log entry
+        deliberately does NOT splice in here — the authoritative
+        suffix arrives with MPGActivate, whose rewind point was
+        computed from this peer's pre-recovery log; appending pushed
+        entries early would make that rewind classify them as
+        divergent and roll back the objects just pushed."""
         pg = self._get_or_create_pg(msg.pgid)
         self._apply_push(pg, msg)
-        if msg.entry_blob:
-            entry = _decode_entry(msg.entry_blob)
-            if entry.version > pg.log.head:
-                pg.log.append(entry)
-                self._persist_entry(pg, entry)
         conn.send(MPGPushReply(tid=msg.tid, from_osd=self.whoami))
 
     def _apply_activate(self, conn: Connection, msg: MPGActivate):
@@ -852,10 +1259,28 @@ class OSD(Dispatcher):
                 # the object existed before the divergent op: its
                 # authoritative state must come back from the primary
                 repull.add(entry.oid)
+        shard = -1
+        if self._is_ec(pg):
+            # my acting position from the authoritative map (this PG
+            # may be freshly created here with no acting cached yet)
+            osdmap = self.monc.osdmap
+            ps = int(pg.pgid.split(".")[1])
+            acting = []
+            if osdmap is not None and pg.pool_id in osdmap.pools:
+                _u, _up, acting, _p = osdmap.pg_to_up_acting_osds(
+                    pg.pool_id, ps
+                )
+            if self.whoami in acting:
+                shard = acting.index(self.whoami)
+            else:
+                repull = set()  # stray shard: next peering re-places it
         for oid in sorted(repull):
             try:
                 reply = conn.call(
-                    MPGPull(pgid=pg.pgid, epoch=msg.epoch, oid=oid)
+                    MPGPull(
+                        pgid=pg.pgid, epoch=msg.epoch, oid=oid,
+                        shard=shard,
+                    )
                 )
             except (MessageError, OSError):
                 continue
@@ -897,8 +1322,16 @@ class OSD(Dispatcher):
             self._handle_log_req(conn, msg)
             return True
         if isinstance(msg, MPGPull):
-            self._handle_pull(conn, msg)
+            if msg.shard >= 0:
+                # erasure reconstruct = nested sub-op RPC → worker
+                self._workq.put(("pull", conn, msg))
+            else:
+                self._handle_pull(conn, msg)
             return True
+        if isinstance(msg, (MECSubRead, MECSubWrite)):
+            # shard-side sub-op service (handle_sub_read/-write,
+            # ECBackend.cc:934,1010): pure store access, serve inline
+            return self._shard_server.ms_dispatch(conn, msg)
         if isinstance(msg, MPGPush):
             self._handle_push(conn, msg)
             return True
@@ -939,6 +1372,8 @@ class OSD(Dispatcher):
                     self._handle_op(item[1], item[2])
                 elif kind == "activate":
                     self._apply_activate(item[1], item[2])
+                elif kind == "pull":
+                    self._handle_pull(item[1], item[2])
             except Exception:  # noqa: BLE001 — worker must survive
                 import traceback
 
@@ -951,11 +1386,27 @@ class OSD(Dispatcher):
                 if pg.state in ("active", "replica", "peering"):
                     peers.update(pg.acting)
         peers.discard(self.whoami)
+        peers.discard(CRUSH_ITEM_NONE)  # EC holes are not peers
         return peers
 
     def _tick_loop(self) -> None:
         while not self._stop.wait(self.tick_interval):
             now = time.monotonic()
+            # retry peering for primary PGs whose recovery pushes
+            # failed (peered_interval cleared) — at tick rate, never
+            # as a hot worker loop
+            retry = False
+            with self._pg_lock:
+                for pg in self.pgs.values():
+                    if (
+                        pg.primary == self.whoami
+                        and pg.acting
+                        and pg.peered_interval is None
+                    ):
+                        retry = True
+                        break
+            if retry:
+                self._workq.put(("map", self.monc.epoch))
             interesting = self._peers_of_interest()
             # peers that left every acting set (e.g. marked down) stop
             # being tracked — a stale last-rx stamp would otherwise

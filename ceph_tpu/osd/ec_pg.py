@@ -1,0 +1,115 @@
+"""Erasure-coded PGs under the OSD daemon — the backend half of
+build_pg_backend's ERASURE branch (src/osd/PGBackend.cc:571-607,
+src/osd/ECBackend.cc).
+
+The daemon mounts the ECStore machinery (store/ec_store.py) as a
+per-PG *view*: position p of the acting set maps to
+
+- the daemon's own ObjectStore when this OSD holds position p,
+- a RemoteStore proxy (MECSubRead/MECSubWrite over the messenger)
+  when a live peer holds it — so gather/decode/minimum-repair reads,
+  including CLAY fractional-chunk recovery reads, travel as real
+  sub-op messages exactly like MOSDECSubOpRead
+  (ECBackend.cc:1010 handle_sub_read), and
+- an UnreachableStore when the position is a CRUSH_ITEM_NONE hole or
+  the peer is down — every access raises StoreError, which is
+  precisely how ECStore's degraded-read/reconstruct paths expect a
+  missing shard to present.
+
+Writes do NOT go through this view: the primary encodes the object,
+builds one per-position transaction (shard bytes + HashInfo + pg_log
+entry + pg_info riding atomically) and fans them out as MOSDRepOp —
+the same logged-replication path replicated pools use, which is what
+keeps ONE peering/recovery machinery for both pool types
+(ECBackend::submit_transaction under PrimaryLogPG, ECBackend.cc:1502).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..ec import ErasureCodeProfile, registry_instance
+from ..ec.stripe import HashInfo, StripeInfo, encode as stripe_encode
+from ..store.objectstore import ObjectStore, StoreError, Transaction
+from ..store.ec_store import HINFO_KEY
+
+DEFAULT_STRIPE_UNIT = 4096  # osd_pool_erasure_code_stripe_unit role
+
+
+class UnreachableStore(ObjectStore):
+    """A shard position with nobody behind it (down OSD or
+    CRUSH_ITEM_NONE hole): every access fails like a dead peer."""
+
+    def _fail(self, *_a, **_kw):
+        raise StoreError("shard unreachable (down or hole)")
+
+    queue_transaction = _fail
+    read = _fail
+    getattr = _fail
+    stat = _fail
+    exists = _fail
+    list_objects = _fail
+    list_collections = _fail
+    list_attrs = _fail
+
+
+class ECCodec:
+    """One pool profile's codec + stripe geometry, cached per profile
+    by the daemon (the ErasureCodePluginRegistry::factory product the
+    reference hangs off the pool, PGBackend.cc:588)."""
+
+    def __init__(self, profile: dict[str, str]):
+        plugin = profile.get("plugin", "jerasure")
+        prof = ErasureCodeProfile(
+            {k: v for k, v in profile.items() if k != "plugin"}
+        )
+        self.ec = registry_instance().factory(plugin, prof)
+        self.k = self.ec.get_data_chunk_count()
+        self.n = self.ec.get_chunk_count()
+        chunk = self.ec.get_chunk_size(self.k * DEFAULT_STRIPE_UNIT)
+        self.sinfo = StripeInfo(self.k, self.k * chunk)
+
+    def encode_object(
+        self, data: bytes
+    ) -> tuple[dict[int, bytes], dict]:
+        """Full-object encode: pad to stripe multiples, run the stripe
+        seam, compute per-shard HashInfo.  Returns ({pos: shard_bytes},
+        meta) with meta in the shard-xattr JSON shape ECStore reads."""
+        logical = len(data)
+        padded_len = self.sinfo.logical_to_next_stripe_offset(logical)
+        padded = data + b"\0" * (padded_len - logical)
+        shards = stripe_encode(self.sinfo, self.ec, padded)
+        if not shards:  # zero-length object: n empty shards
+            shards = {
+                i: np.zeros(0, dtype=np.uint8) for i in range(self.n)
+            }
+        hinfo = HashInfo(self.n)
+        hinfo.append(0, shards)
+        meta = {
+            "size": logical,
+            "hashes": hinfo.cumulative_shard_hashes,
+        }
+        return {i: bytes(shards[i]) for i in range(self.n)}, meta
+
+
+def shard_write_txn(
+    cid: str,
+    oid: str,
+    shard: bytes,
+    meta: dict,
+    attrs: dict[str, bytes] | None = None,
+) -> Transaction:
+    """One position's full-shard write as an unconditional transaction
+    (touch+truncate replaces remove-if-exists so the SAME op list
+    applies on a replica that may not hold the object yet)."""
+    txn = Transaction()
+    txn.touch(cid, oid)
+    txn.truncate(cid, oid, 0)
+    if shard:
+        txn.write(cid, oid, 0, shard)
+    txn.setattr(cid, oid, HINFO_KEY, json.dumps(meta).encode())
+    for name, value in (attrs or {}).items():
+        txn.setattr(cid, oid, name, value)
+    return txn

@@ -92,9 +92,19 @@ class ECStore:
         profile: dict | None = None,
         stores: list[ObjectStore] | None = None,
         stripe_width: int | None = None,
+        *,
+        ec=None,
+        cid: str = "ec_pool",
+        ensure_collections: bool = True,
     ):
-        prof = ErasureCodeProfile(profile or {})
-        self.ec = registry_instance().factory(plugin, prof)
+        """``ec`` accepts a prebuilt codec (skipping the registry
+        factory); ``cid``/``ensure_collections`` let the OSD daemon
+        mount this machinery as a per-PG view over its own collection
+        and remote peers (the ECBackend-under-PrimaryLogPG shape)."""
+        if ec is None:
+            prof = ErasureCodeProfile(profile or {})
+            ec = registry_instance().factory(plugin, prof)
+        self.ec = ec
         self.k = self.ec.get_data_chunk_count()
         self.n = self.ec.get_chunk_count()
         chunk = self.ec.get_chunk_size(
@@ -103,14 +113,15 @@ class ECStore:
         self.sinfo = StripeInfo(self.k, self.k * chunk)
         self.stores = stores or [MemStore() for _ in range(self.n)]
         assert len(self.stores) == self.n
-        self.cid = "ec_pool"
-        for store in self.stores:
-            try:
-                store.queue_transaction(
-                    Transaction().create_collection(self.cid)
-                )
-            except StoreError:
-                pass  # already created
+        self.cid = cid
+        if ensure_collections:
+            for store in self.stores:
+                try:
+                    store.queue_transaction(
+                        Transaction().create_collection(self.cid)
+                    )
+                except StoreError:
+                    pass  # already created (or shard unreachable)
         # RMW pipeline state: per-object FIFO tickets (the reference's
         # waiting_state/waiting_reads/waiting_commit op lists collapse
         # to "ops on one object run in submission order"; ops on
@@ -317,6 +328,14 @@ class ECStore:
                 continue
         raise ErasureCodeError(f"object {name} not found (-ENOENT)")
 
+    def meta(self, name: str) -> dict:
+        """Object meta ({"size", "hashes"}) from the first reachable
+        shard's HashInfo xattr (raises ErasureCodeError on -ENOENT)."""
+        return self._shard_meta(name)
+
+    def size(self, name: str) -> int:
+        return self._shard_meta(name)["size"]
+
     def _read_verified(self, name: str, meta: dict, shard: int):
         try:
             raw = self.stores[shard].read(self.cid, name)
@@ -412,7 +431,9 @@ class ECStore:
                     break
         return result
 
-    def recover_shard(self, name: str, shard: int) -> int:
+    def recover_shard(
+        self, name: str, shard: int, meta: dict | None = None
+    ) -> int:
         """Rebuild one shard from its minimum read set and rewrite it
         (RecoveryOp: READING -> WRITING).  Reads are REAL ranged
         store reads; a failed rebuild crc (silently corrupt helper)
@@ -420,12 +441,28 @@ class ECStore:
         bytes read."""
         ticket = self._enter(name)
         try:
-            return self._recover_locked(name, shard)
+            return self._recover_locked(name, shard, meta)
         finally:
             self._exit(name, ticket)
 
-    def _recover_locked(self, name: str, shard: int) -> int:
-        meta = self._shard_meta(name)
+    def _recover_locked(self, name: str, shard: int, meta=None) -> int:
+        rebuilt, read_bytes, meta = self.reconstruct_shard(
+            name, shard, meta
+        )
+        self._write_shard(self.stores[shard], name, rebuilt, meta)
+        return read_bytes
+
+    def reconstruct_shard(
+        self, name: str, shard: int, meta: dict | None = None
+    ) -> tuple[bytes, int, dict]:
+        """Rebuild one shard's bytes WITHOUT writing them — the OSD
+        daemon uses this to serve recovery pulls and pushes where the
+        write travels in its own logged transaction.  ``meta`` lets an
+        authoritative caller pin the HashInfo (a rewinding peer may
+        still hold stale hinfo).  Returns (bytes, helper_bytes_read,
+        meta)."""
+        if meta is None:
+            meta = self._shard_meta(name)
         available = set()
         for i in range(self.n):
             if i == shard:
@@ -464,10 +501,7 @@ class ECStore:
                 raise ErasureCodeError(
                     f"rebuilt shard {shard} fails its hinfo crc (-EIO)"
                 )
-        self._write_shard(
-            self.stores[shard], name, bytes(rebuilt), meta
-        )
-        return read_bytes
+        return bytes(rebuilt), read_bytes, meta
 
     def _repair_minimum(self, name, meta, shard, available):
         """Minimum-read rebuild with ranged reads (trusting helpers,

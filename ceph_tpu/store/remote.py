@@ -35,8 +35,10 @@ from ..msg import (
     MessageError,
     Messenger,
 )
+from ..common.encoding import Decoder, Encoder
 from ..msg.message import (
     READ_ATTR,
+    READ_ATTRS,
     READ_DATA,
     READ_EXISTS,
     READ_LIST,
@@ -102,6 +104,14 @@ class ShardServer(Dispatcher):
             return b"\1" if s.exists(cid, oid) else b"\0"
         if kind == READ_LIST:
             return "\0".join(s.list_objects(cid)).encode()
+        if kind == READ_ATTRS:
+            e = Encoder()
+            e.map(
+                s.list_attrs(cid, oid),
+                lambda e2, k: e2.string(k),
+                lambda e2, v: e2.bytes(v),
+            )
+            return e.getvalue()
         raise StoreError(f"unknown read kind {kind}")
 
 
@@ -177,6 +187,12 @@ class RemoteStore(ObjectStore):
     def list_objects(self, cid) -> list[str]:
         raw = self._one(READ_LIST, cid, "")
         return raw.decode().split("\0") if raw else []
+
+    def list_attrs(self, cid, oid) -> dict[str, bytes]:
+        raw = self._one(READ_ATTRS, cid, oid)
+        return Decoder(raw).map(
+            lambda d: d.string(), lambda d: d.bytes()
+        )
 
     def ping(self, from_osd: int = -1, timeout: float = 5.0) -> float:
         """Heartbeat round trip; returns rtt seconds (raises
