@@ -581,25 +581,80 @@ class MonClient(Dispatcher):
         self.on_map = on_map
         self.osdmap: OSDMap | None = None
         self._conn: Connection | None = None
+        self._addrs: list[tuple[str, int]] = []
         self._lock = threading.Lock()
         self._epoch_event = threading.Condition(self._lock)
         messenger.add_dispatcher(self)
 
     # -- session -----------------------------------------------------------
     def connect(self, host: str, port: int) -> None:
-        self._conn = self.messenger.connect(host, port)
+        if (host, int(port)) not in self._addrs:
+            self._addrs.append((host, int(port)))
+        self._conn = self.messenger.connect(host, int(port))
         reply = self._conn.call(
             MMonSubscribe(start_epoch=0, from_osd=self.whoami)
         )
         assert isinstance(reply, MOSDMap)
         self._apply(reply)
 
-    def command(self, cmd: dict) -> MMonCommandReply:
-        reply = self._conn.call(MMonCommand(cmd=json.dumps(cmd)))
-        assert isinstance(reply, MMonCommandReply)
-        return reply
+    def connect_any(self, addrs) -> None:
+        """Session to the first reachable monitor of a quorum
+        (MonClient::get_monmap_and_config's mon-list behavior)."""
+        self._addrs = [(h, int(p)) for h, p in addrs]
+        self.ensure_connected()
+
+    def ensure_connected(self) -> None:
+        """(Re)establish the mon session, cycling the known monitor
+        addresses — the client half of monitor failover."""
+        if self._conn is not None and not self._conn.is_closed:
+            return
+        last: Exception | None = None
+        for host, port in self._addrs:
+            try:
+                self._conn = self.messenger.connect(host, port)
+                reply = self._conn.call(
+                    MMonSubscribe(start_epoch=0, from_osd=self.whoami)
+                )
+                assert isinstance(reply, MOSDMap)
+                self._apply(reply)
+                return
+            except (MessageError, OSError, AssertionError) as e:
+                last = e
+        raise MessageError(f"no monitor reachable: {last}")
+
+    def command(
+        self, cmd: dict, timeout: float = 15.0
+    ) -> MMonCommandReply:
+        """Mon command with failover: retries across monitors on
+        connection loss and waits out elections (-EAGAIN replies), the
+        MonClient::start_mon_command resend behavior."""
+        deadline = time.monotonic() + timeout
+        payload = json.dumps(cmd)
+        last_err: Exception | None = None
+        while True:
+            try:
+                self.ensure_connected()
+                reply = self._conn.call(MMonCommand(cmd=payload))
+                assert isinstance(reply, MMonCommandReply)
+                if reply.rc == -11 and "-EAGAIN" in reply.outs:
+                    # electing: wait and resend
+                    if time.monotonic() >= deadline:
+                        return reply
+                    time.sleep(0.2)
+                    continue
+                return reply
+            except (MessageError, OSError, AssertionError) as e:
+                last_err = e
+                if self._conn is not None:
+                    self._conn.close()
+                if time.monotonic() >= deadline:
+                    raise MessageError(
+                        f"mon command failed: {last_err}"
+                    ) from last_err
+                time.sleep(0.2)
 
     def report_failure(self, target: int, failed_for: float) -> None:
+        self.ensure_connected()
         self._conn.send(
             MOSDFailure(
                 target=target,
@@ -610,6 +665,7 @@ class MonClient(Dispatcher):
         )
 
     def boot(self, osd: int, addr: str = "") -> None:
+        self.ensure_connected()
         self._conn.send(MOSDBoot(osd=osd, addr=addr))
 
     @property

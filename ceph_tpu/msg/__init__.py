@@ -24,6 +24,8 @@ AsyncMessenger plays beneath the OSDs.
 
 from .message import (
     MECSubRead,
+    MMonElection,
+    MMonPaxos,
     MECSubReadReply,
     MECSubWrite,
     MECSubWriteReply,
@@ -54,6 +56,8 @@ __all__ = [
     "MECSubReadReply",
     "MECSubWrite",
     "MECSubWriteReply",
+    "MMonElection",
+    "MMonPaxos",
     "MOSDMap",
     "MOSDOp",
     "MOSDOpReply",

@@ -608,6 +608,90 @@ class MPGPushReply(Message):
         return cls(from_osd=d.s32(), ok=d.bool())
 
 
+# election ops (Elector.cc / ElectionLogic.cc roles)
+ELECT_PROPOSE = 0
+ELECT_ACK = 1
+ELECT_VICTORY = 2
+
+
+@register_message
+@dataclass
+class MMonElection(Message):
+    """Monitor election (MMonElection): PROPOSE carries the
+    candidate's (last_committed, rank) so peers defer to the most
+    up-to-date, lowest-rank candidate; ACK endorses a proposal epoch;
+    VICTORY announces the leader + quorum."""
+
+    TYPE = 24
+    op: int = ELECT_PROPOSE
+    epoch: int = 0
+    rank: int = -1
+    last_committed: int = 0
+    quorum: list = field(default_factory=list)
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.u8(self.op).u32(self.epoch).s32(self.rank)
+        e.u64(self.last_committed)
+        e.list(self.quorum, lambda e2, r: e2.s32(r))
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MMonElection":
+        return cls(
+            op=d.u8(), epoch=d.u32(), rank=d.s32(),
+            last_committed=d.u64(),
+            quorum=d.list(lambda d2: d2.s32()),
+        )
+
+
+# paxos ops (Paxos.cc collect/begin/accept/commit/lease)
+PAXOS_COLLECT = 0
+PAXOS_LAST = 1
+PAXOS_BEGIN = 2
+PAXOS_ACCEPT = 3
+PAXOS_COMMIT = 4
+PAXOS_LEASE = 5
+PAXOS_SYNC = 6  # lagging peon asks the leader for missing commits
+
+
+@register_message
+@dataclass
+class MMonPaxos(Message):
+    """Paxos round message (MMonPaxos): ``epoch`` is the election
+    epoch guarding against deposed leaders (the pn role), ``version``
+    the map epoch being proposed/committed.  ``entries`` carries
+    catch-up runs of (version, inc_blob, full_blob)."""
+
+    TYPE = 25
+    op: int = PAXOS_COLLECT
+    epoch: int = 0
+    version: int = 0
+    last_committed: int = 0
+    ok: bool = True
+    rank: int = -1
+    inc_blob: bytes = b""
+    full_blob: bytes = b""
+    entries: list = field(default_factory=list)
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.u8(self.op).u32(self.epoch).u64(self.version)
+        e.u64(self.last_committed).bool(self.ok).s32(self.rank)
+        e.bytes(self.inc_blob).bytes(self.full_blob)
+        e.u32(len(self.entries))
+        for v, inc, full in self.entries:
+            e.u64(v).bytes(inc).bytes(full)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MMonPaxos":
+        msg = cls(
+            op=d.u8(), epoch=d.u32(), version=d.u64(),
+            last_committed=d.u64(), ok=d.bool(), rank=d.s32(),
+            inc_blob=d.bytes(), full_blob=d.bytes(),
+        )
+        for _ in range(d.u32()):
+            msg.entries.append((d.u64(), d.bytes(), d.bytes()))
+        return msg
+
+
 @register_message
 @dataclass
 class MPGActivate(Message):

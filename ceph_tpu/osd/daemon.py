@@ -209,9 +209,15 @@ class OSD(Dispatcher):
         self._last_up: dict[int, bool] = {}
 
     # -- lifecycle ---------------------------------------------------------
-    def boot(self, mon_host: str, mon_port: int) -> None:
+    def boot(
+        self,
+        mon_host: str | None = None,
+        mon_port: int | None = None,
+        mon_addrs=None,
+    ) -> None:
         """bind → load PGs from disk → mon session → announce
-        (OSD::init + start_boot)."""
+        (OSD::init + start_boot).  ``mon_addrs`` (a list of
+        (host, port)) enables failover across a monitor quorum."""
         self.addr = self.messenger.bind()
         self._load_pgs()
         self._worker = threading.Thread(
@@ -219,7 +225,10 @@ class OSD(Dispatcher):
             daemon=True,
         )
         self._worker.start()
-        self.monc.connect(mon_host, mon_port)
+        if mon_addrs is not None:
+            self.monc.connect_any(mon_addrs)
+        else:
+            self.monc.connect(mon_host, mon_port)
         self.monc.boot(self.whoami, addr=f"{self.addr[0]}:{self.addr[1]}")
         self._ticker = threading.Thread(
             target=self._tick_loop, name=f"osd.{self.whoami}.tick",
@@ -1407,6 +1416,27 @@ class OSD(Dispatcher):
                         break
             if retry:
                 self._workq.put(("map", self.monc.epoch))
+            # mon session failover (MonClient reconnect)
+            try:
+                self.monc.ensure_connected()
+            except (MessageError, OSError):
+                pass
+            # re-announce until the map marks us up — a boot report
+            # can be lost while the mon quorum is electing
+            # (OSD::start_boot retries the same way)
+            osdmap = self.monc.osdmap
+            if (
+                osdmap is not None
+                and self.addr is not None
+                and not osdmap.is_up(self.whoami)
+            ):
+                try:
+                    self.monc.boot(
+                        self.whoami,
+                        addr=f"{self.addr[0]}:{self.addr[1]}",
+                    )
+                except (MessageError, OSError):
+                    pass
             interesting = self._peers_of_interest()
             # peers that left every acting set (e.g. marked down) stop
             # being tracked — a stale last-rx stamp would otherwise

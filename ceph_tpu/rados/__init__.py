@@ -58,6 +58,13 @@ class Rados:
         self._connected = True
         return self
 
+    def connect_any(self, mon_addrs) -> "Rados":
+        """Connect to the first reachable monitor of a quorum; the
+        session fails over between monitors afterwards."""
+        self.monc.connect_any(mon_addrs)
+        self._connected = True
+        return self
+
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)
         self.messenger.shutdown()

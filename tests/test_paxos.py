@@ -1,0 +1,263 @@
+"""Monitor quorum — elections, Paxos commits, leader failover, peon
+catch-up, and client/daemon failover between monitors
+(src/mon/Paxos.cc, src/mon/Elector.cc, the VERDICT round-2 item #2
+acceptance walk)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.crush.builder import CrushMap
+from ceph_tpu.crush.types import CRUSH_BUCKET_STRAW2, Tunables
+from ceph_tpu.mon.monitor import MonClient, MonitorStore
+from ceph_tpu.mon.quorum import (
+    STATE_LEADER,
+    STATE_PEON,
+    MonMap,
+    QuorumMonitor,
+)
+from ceph_tpu.msg import Messenger
+from ceph_tpu.msg.messenger import wait_for
+from ceph_tpu.osd.daemon import OSD
+from ceph_tpu.osd.osdmap import OSDMap, PgPool
+from ceph_tpu.rados import Rados
+
+N_MON = 3
+N_OSD = 3
+POOL = 1
+
+
+def _base_map(n_osd: int) -> OSDMap:
+    cmap = CrushMap(tunables=Tunables())
+    hosts = []
+    for h in range(n_osd):
+        hosts.append(
+            cmap.add_bucket(
+                CRUSH_BUCKET_STRAW2, 1, [h], [0x10000],
+                name=f"host{h}",
+            )
+        )
+    cmap.add_bucket(
+        CRUSH_BUCKET_STRAW2, 3, hosts,
+        [cmap.buckets[b].weight for b in hosts], name="default",
+    )
+    cmap.add_simple_rule("rep", "default", "host", mode="firstn")
+    om = OSDMap.build(cmap, n_osd)
+    om.add_pool(PgPool(pool_id=POOL, size=3, pg_num=2, crush_rule=0))
+    return om
+
+
+def _free_ports(n: int) -> list[int]:
+    import socket
+
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class MonCluster:
+    """N QuorumMonitors over real messengers."""
+
+    def __init__(self, n_mon: int = N_MON, n_osd: int = N_OSD):
+        ports = _free_ports(n_mon)
+        self.monmap = MonMap(
+            addrs={r: ("127.0.0.1", ports[r]) for r in range(n_mon)}
+        )
+        self.mons: dict[int, QuorumMonitor] = {}
+        self.stores: dict[int, MonitorStore] = {}
+        for r in range(n_mon):
+            self.start_mon(r, _base_map(n_osd))
+
+    def start_mon(self, rank: int, osdmap=None) -> QuorumMonitor:
+        store = self.stores.get(rank) or MonitorStore()
+        self.stores[rank] = store
+        mon = QuorumMonitor(
+            osdmap if osdmap is not None else _base_map(N_OSD),
+            self.monmap,
+            rank,
+            store=store,
+            min_reporters=2,
+            election_timeout=0.5,
+            lease_interval=0.25,
+        )
+        mon.start()
+        self.mons[rank] = mon
+        return mon
+
+    def kill_mon(self, rank: int) -> None:
+        mon = self.mons.pop(rank)
+        mon.shutdown()
+
+    def leader(self) -> QuorumMonitor | None:
+        for mon in self.mons.values():
+            if mon.state == STATE_LEADER:
+                return mon
+        return None
+
+    def wait_quorum(self, timeout: float = 10.0) -> QuorumMonitor:
+        def settled():
+            leaders = [
+                m for m in self.mons.values()
+                if m.state == STATE_LEADER
+            ]
+            if len(leaders) != 1:
+                return False
+            lead = leaders[0]
+            live = set(self.mons)
+            return (
+                lead.quorum >= live
+                and all(
+                    self.mons[r].state == STATE_PEON
+                    and self.mons[r].leader == lead.rank
+                    for r in live - {lead.rank}
+                )
+            )
+
+        assert wait_for(settled, timeout), {
+            r: (m.state, m.leader) for r, m in self.mons.items()
+        }
+        return self.leader()
+
+    def addrs(self):
+        return list(self.monmap.addrs.values())
+
+    def shutdown(self):
+        for r in list(self.mons):
+            self.kill_mon(r)
+
+
+@pytest.fixture
+def cluster():
+    c = MonCluster()
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+def test_election_and_replicated_commits(cluster):
+    leader = cluster.wait_quorum()
+    # one leader, everyone else a peon following it (which rank wins
+    # can race: a late counter-proposal legitimately loses to an
+    # already-announced victory)
+    assert leader.rank in cluster.mons
+    # a command committed on the leader replicates to every mon
+    client = Rados("paxos-client").connect_any(cluster.addrs())
+    try:
+        client.pool_create("qpool", pg_num=2)
+        assert wait_for(
+            lambda: all(
+                "qpool" in m.osdmap.pool_names.values()
+                for m in cluster.mons.values()
+            ),
+            5.0,
+        ), "commit did not replicate to all mons"
+        # every mon's store has the same last_committed chain
+        lcs = {
+            r: m.store.last_committed()
+            for r, m in cluster.mons.items()
+        }
+        assert len(set(lcs.values())) == 1, lcs
+    finally:
+        client.shutdown()
+
+
+def test_leader_death_reelection_and_catchup(cluster):
+    leader = cluster.wait_quorum()
+    dead = leader.rank
+    client = Rados("paxos-client2").connect_any(cluster.addrs())
+    try:
+        client.pool_create("pre-kill", pg_num=2)
+        cluster.kill_mon(dead)
+        # surviving quorum elects and keeps committing
+        new_leader = cluster.wait_quorum()
+        assert new_leader.rank != dead
+        client.pool_create("post-kill", pg_num=2)
+        assert wait_for(
+            lambda: all(
+                "post-kill" in m.osdmap.pool_names.values()
+                for m in cluster.mons.values()
+            ),
+            5.0,
+        )
+        # the dead mon rejoins (same store) and catches up
+        cluster.start_mon(dead)
+        assert wait_for(
+            lambda: cluster.mons[dead].in_quorum
+            and "post-kill"
+            in cluster.mons[dead].osdmap.pool_names.values(),
+            10.0,
+        ), (
+            cluster.mons[dead].state,
+            list(cluster.mons[dead].osdmap.pool_names.values()),
+        )
+        # and the cluster still commits with all three back
+        cluster.wait_quorum()
+        client.pool_create("post-rejoin", pg_num=2)
+        assert wait_for(
+            lambda: all(
+                "post-rejoin" in m.osdmap.pool_names.values()
+                for m in cluster.mons.values()
+            ),
+            5.0,
+        )
+    finally:
+        client.shutdown()
+
+
+def test_osd_and_client_failover_between_mons(cluster):
+    """OSD daemons boot against the quorum, serve I/O, and keep
+    working after the leader (their likely session mon) dies."""
+    cluster.wait_quorum()
+    osds: dict[int, OSD] = {}
+    client = Rados("paxos-io").connect_any(cluster.addrs())
+    try:
+        for i in range(N_OSD):
+            osd = OSD(i, tick_interval=0.2, heartbeat_grace=1.0)
+            osd.boot(mon_addrs=cluster.addrs())
+            osds[i] = osd
+        # all mons converge on the osd boot state
+        assert wait_for(
+            lambda: all(
+                sum(
+                    1
+                    for o in range(N_OSD)
+                    if m.osdmap.is_up(o)
+                )
+                == N_OSD
+                for m in cluster.mons.values()
+            ),
+            10.0,
+        )
+        io = client.open_ioctx("rbd") if False else None
+        client.pool_create("iopool", pg_num=2, size=3)
+        ioctx = client.open_ioctx("iopool")
+        ioctx.write_full("a", b"alpha")
+        assert ioctx.read("a") == b"alpha"
+        # kill the current leader; quorum re-forms; I/O continues
+        leader = cluster.leader()
+        cluster.kill_mon(leader.rank)
+        cluster.wait_quorum()
+        ioctx.write_full("b", b"beta")
+        assert ioctx.read("b") == b"beta"
+        assert ioctx.read("a") == b"alpha"
+        # an OSD killed now is still marked down by the new quorum
+        victim = 2
+        osds.pop(victim).shutdown()
+        assert wait_for(
+            lambda: not client.monc.osdmap.is_up(victim), 15.0
+        ), "surviving quorum never marked the dead OSD down"
+        ioctx.write_full("c", b"gamma")
+        assert ioctx.read("c") == b"gamma"
+    finally:
+        client.shutdown()
+        for osd in osds.values():
+            osd.shutdown()
