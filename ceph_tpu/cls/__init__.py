@@ -47,13 +47,23 @@ class MethodContext:
     """The objclass API surface handed to methods (class_api.cc):
     reads hit the live object; writes stage into the op's transaction."""
 
-    def __init__(self, read_fn, attrs: dict[str, bytes], exists: bool):
+    def __init__(
+        self,
+        read_fn,
+        attrs: dict[str, bytes],
+        exists: bool,
+        omap_fn=None,
+    ):
         self._read = read_fn
         self._attrs = dict(attrs)
+        self._omap_fn = omap_fn
+        self._omap_cache: dict[str, bytes] | None = None
         self.exists = exists
         # staged mutations the OSD materializes into the txn
         self.new_data: bytes | None = None
         self.new_attrs: dict[str, bytes] = {}
+        self.new_omap: dict[str, bytes] = {}
+        self.rm_omap: set[str] = set()
         self.removed = False
 
     # -- reads (cls_cxx_read / stat / getxattr) ----------------------------
@@ -70,6 +80,37 @@ class MethodContext:
             return self.new_attrs[name]
         return self._attrs.get(name)
 
+    # -- omap (cls_cxx_map_get_val / get_vals / set_val / remove_key) ------
+    def _omap_base(self) -> dict[str, bytes]:
+        if self._omap_cache is None:
+            self._omap_cache = (
+                dict(self._omap_fn())
+                if self._omap_fn is not None and self.exists
+                else {}
+            )
+        return self._omap_cache
+
+    def omap_get(self) -> dict[str, bytes]:
+        """Merged view: stored omap + staged writes of THIS op."""
+        merged = dict(self._omap_base())
+        for k in self.rm_omap:
+            merged.pop(k, None)
+        merged.update(self.new_omap)
+        return merged
+
+    def omap_get_val(self, key: str) -> bytes | None:
+        return self.omap_get().get(key)
+
+    def omap_set(self, kv: dict[str, bytes]) -> None:
+        for k, v in kv.items():
+            self.new_omap[k] = bytes(v)
+            self.rm_omap.discard(k)
+
+    def omap_rm(self, keys) -> None:
+        for k in keys:
+            self.rm_omap.add(k)
+            self.new_omap.pop(k, None)
+
     # -- staged writes (cls_cxx_write_full / setxattr / remove) ------------
     def write_full(self, data: bytes) -> None:
         self.new_data = bytes(data)
@@ -81,6 +122,16 @@ class MethodContext:
     def remove(self) -> None:
         self.removed = True
         self.new_data = None
+
+    @property
+    def has_staged_writes(self) -> bool:
+        return bool(
+            self.new_data is not None
+            or self.new_attrs
+            or self.new_omap
+            or self.rm_omap
+            or self.removed
+        )
 
 
 class ClassHandler:
@@ -204,24 +255,65 @@ def _version_read(ctx: MethodContext, indata: bytes) -> bytes:
     return ctx.getxattr("cls_version") or b"0"
 
 
+# cls_log (src/cls/log/cls_log.cc): entries live in the OMAP keyed by
+# zero-padded "<stamp>.<seq>" so listing pages in time order and trim
+# is a ranged key removal — the index-style workload omap exists for.
+
+_LOG_SEQ_ATTR = "cls_log_seq"
+
+
+def _log_key(stamp: float, seq: int) -> str:
+    return f"{stamp:020.6f}.{seq:012d}"
+
+
 @default_handler.cls_method("log", "add", WR)
 def _log_add(ctx: MethodContext, indata: bytes) -> bytes:
-    """cls_log add: timestamped line appended to the object."""
-    line = json.dumps(
-        {"stamp": time.time(), "entry": indata.decode()}
-    ).encode()
-    ctx.write_full(ctx.read() + line + b"\n")
+    """cls_log add: one omap entry per line, timestamp-ordered keys."""
+    seq = int(ctx.getxattr(_LOG_SEQ_ATTR) or b"0")
+    entries = json.loads(indata) if indata.startswith(b"[") else [
+        indata.decode()
+    ]
+    now = time.time()
+    staged: dict[str, bytes] = {}
+    for entry in entries:
+        seq += 1
+        staged[_log_key(now, seq)] = json.dumps(
+            {"stamp": now, "entry": entry}
+        ).encode()
+    ctx.omap_set(staged)
+    ctx.setxattr(_LOG_SEQ_ATTR, str(seq).encode())
     return b""
 
 
 @default_handler.cls_method("log", "list", RD)
 def _log_list(ctx: MethodContext, indata: bytes) -> bytes:
-    return ctx.read()
+    """cls_log list: [from_key, max] page of entries in key order."""
+    req = json.loads(indata) if indata else {}
+    start = req.get("from", "")
+    limit = int(req.get("max", -1))
+    omap = ctx.omap_get()
+    out = []
+    for key in sorted(omap):
+        if start and key <= start:
+            continue
+        out.append({"key": key, **json.loads(omap[key])})
+        if 0 <= limit <= len(out):
+            break
+    return json.dumps(out).encode()
 
 
 @default_handler.cls_method("log", "trim", WR)
 def _log_trim(ctx: MethodContext, indata: bytes) -> bytes:
-    keep = int(indata or b"0")
-    lines = ctx.read().splitlines(keepends=True)
-    ctx.write_full(b"".join(lines[len(lines) - keep :] if keep else []))
+    """cls_log trim: remove entries with key <= to_key (or keep the
+    newest N when indata is a bare integer)."""
+    omap = ctx.omap_get()
+    keys = sorted(omap)
+    if indata.isdigit():
+        keep = int(indata)
+        doomed = keys[: max(0, len(keys) - keep)]
+    else:
+        req = json.loads(indata) if indata else {}
+        to_key = req.get("to", "")
+        doomed = [k for k in keys if k <= to_key]
+    ctx.omap_rm(doomed)
     return b""

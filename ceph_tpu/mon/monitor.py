@@ -582,6 +582,7 @@ class MonClient(Dispatcher):
         self.osdmap: OSDMap | None = None
         self._conn: Connection | None = None
         self._addrs: list[tuple[str, int]] = []
+        self._reconnect_lock = threading.Lock()
         self._lock = threading.Lock()
         self._epoch_event = threading.Condition(self._lock)
         messenger.add_dispatcher(self)
@@ -608,19 +609,45 @@ class MonClient(Dispatcher):
         addresses — the client half of monitor failover."""
         if self._conn is not None and not self._conn.is_closed:
             return
-        last: Exception | None = None
-        for host, port in self._addrs:
-            try:
-                self._conn = self.messenger.connect(host, port)
-                reply = self._conn.call(
-                    MMonSubscribe(start_epoch=0, from_osd=self.whoami)
-                )
-                assert isinstance(reply, MOSDMap)
-                self._apply(reply)
+        with self._reconnect_lock:
+            if self._conn is not None and not self._conn.is_closed:
                 return
-            except (MessageError, OSError, AssertionError) as e:
-                last = e
-        raise MessageError(f"no monitor reachable: {last}")
+            last: Exception | None = None
+            for host, port in self._addrs:
+                try:
+                    conn = self.messenger.connect(host, port)
+                    reply = conn.call(
+                        MMonSubscribe(
+                            start_epoch=0, from_osd=self.whoami
+                        )
+                    )
+                    assert isinstance(reply, MOSDMap)
+                    self._conn = conn
+                    self._apply(reply)
+                    return
+                except (MessageError, OSError, AssertionError) as e:
+                    last = e
+            raise MessageError(f"no monitor reachable: {last}")
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        """Session mon died: re-subscribe elsewhere EAGERLY — a
+        client that only watches the map would otherwise go stale
+        until its next command (MonClient::_reopen_session)."""
+        if conn is not self._conn or not self._addrs:
+            return
+        threading.Thread(
+            target=self._reconnect_bg,
+            name="monc.reconnect",
+            daemon=True,
+        ).start()
+
+    def _reconnect_bg(self) -> None:
+        for _ in range(100):
+            try:
+                self.ensure_connected()
+                return
+            except (MessageError, OSError):
+                time.sleep(0.2)
 
     def command(
         self, cmd: dict, timeout: float = 15.0

@@ -180,6 +180,7 @@ READ_STAT = 2  # (cid, oid) -> size
 READ_EXISTS = 3  # (cid, oid) -> bool
 READ_LIST = 4  # (cid,) -> [oid]
 READ_ATTRS = 5  # (cid, oid) -> encoded {name: value} map
+READ_OMAP = 6  # (cid, oid) -> encoded {key: value} map
 
 
 @register_message
@@ -366,6 +367,10 @@ OSD_OP_GETXATTR = 6
 OSD_OP_LIST = 7  # list this PG's objects (the pgls op)
 OSD_OP_APPEND = 8  # atomic append (offset resolved on the primary)
 OSD_OP_CALL = 9  # object-class call (attr='cls.method', data=indata)
+OSD_OP_OMAPSET = 10  # data = encoded {key: value} map
+OSD_OP_OMAPGET = 11  # attr = start_after, length = max_return
+OSD_OP_OMAPRM = 12  # data = encoded [key] list
+OSD_OP_OMAPCLEAR = 13
 
 
 @register_message
@@ -571,6 +576,7 @@ class MPGPush(Message):
     exists: bool = True
     data: bytes = b""
     attrs: dict = field(default_factory=dict)
+    omap: dict = field(default_factory=dict)
     entry_blob: bytes = b""  # the log entry that names this version
 
     def encode_payload(self, e: Encoder) -> None:
@@ -578,6 +584,11 @@ class MPGPush(Message):
         e.bool(self.exists).bytes(self.data)
         e.map(
             self.attrs,
+            lambda e2, k: e2.string(k),
+            lambda e2, v: e2.bytes(v),
+        )
+        e.map(
+            self.omap,
             lambda e2, k: e2.string(k),
             lambda e2, v: e2.bytes(v),
         )
@@ -589,6 +600,7 @@ class MPGPush(Message):
             pgid=d.string(), epoch=d.u32(), oid=d.string(),
             exists=d.bool(), data=d.bytes(),
             attrs=d.map(lambda d2: d2.string(), lambda d2: d2.bytes()),
+            omap=d.map(lambda d2: d2.string(), lambda d2: d2.bytes()),
             entry_blob=d.bytes(),
         )
 

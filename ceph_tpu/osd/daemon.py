@@ -85,6 +85,10 @@ from ..msg.message import (
     OSD_OP_DELETE,
     OSD_OP_GETXATTR,
     OSD_OP_LIST,
+    OSD_OP_OMAPCLEAR,
+    OSD_OP_OMAPGET,
+    OSD_OP_OMAPRM,
+    OSD_OP_OMAPSET,
     OSD_OP_READ,
     OSD_OP_SETXATTR,
     OSD_OP_STAT,
@@ -556,6 +560,8 @@ class OSD(Dispatcher):
                 txn.write(pg.cid, store_oid, 0, push.data)
             for k, v in push.attrs.items():
                 txn.setattr(pg.cid, store_oid, k, v)
+            if push.omap:
+                txn.omap_setkeys(pg.cid, store_oid, push.omap)
         if txn.ops:
             self.store.queue_transaction(txn)
 
@@ -615,20 +621,23 @@ class OSD(Dispatcher):
         return True
 
     def _push_for(self, pg: PG, epoch: int, oid: str) -> MPGPush:
-        """One object's recovery push, attrs included (prep_push)."""
+        """One object's recovery push, attrs + omap included
+        (prep_push)."""
         entry = pg.log.object_op(oid)
         exists = entry is None or entry.op != DELETE
         data = b""
         attrs: dict[str, bytes] = {}
+        omap: dict[str, bytes] = {}
         if exists:
             try:
                 data = self.store.read(pg.cid, OBJ_PREFIX + oid)
                 attrs = self.store.list_attrs(pg.cid, OBJ_PREFIX + oid)
+                omap = self.store.omap_get(pg.cid, OBJ_PREFIX + oid)
             except StoreError:
                 exists = False
         return MPGPush(
             pgid=pg.pgid, epoch=epoch, oid=oid,
-            exists=exists, data=data, attrs=attrs,
+            exists=exists, data=data, attrs=attrs, omap=omap,
             entry_blob=_encode_entry(entry) if entry else b"",
         )
 
@@ -668,17 +677,20 @@ class OSD(Dispatcher):
                 return push
             raise
         attrs = {HINFO_KEY: json.dumps(meta).encode()}
-        # user/class attrs replicate on every shard — take them from
-        # our copy, or any reachable shard when ours is gone
+        # user/class attrs and omap replicate on every shard — take
+        # them from our copy, or any reachable shard when ours is gone
         src_attrs = None
+        src_omap: dict[str, bytes] = {}
         if self.store.exists(pg.cid, store_oid):
             src_attrs = self.store.list_attrs(pg.cid, store_oid)
+            src_omap = self._omap_of(pg, store_oid)
         else:
             for i, st in enumerate(ecs.stores):
                 if i == pos:
                     continue
                 try:
                     src_attrs = st.list_attrs(pg.cid, store_oid)
+                    src_omap = st.omap_get(pg.cid, store_oid)
                     break
                 except StoreError:
                     continue
@@ -693,6 +705,7 @@ class OSD(Dispatcher):
         push.exists = True
         push.data = data
         push.attrs = attrs
+        push.omap = src_omap
         return push
 
     # -- persistence -------------------------------------------------------
@@ -763,6 +776,20 @@ class OSD(Dispatcher):
                     reply.data = self._cls_call(
                         cls_name, method, ctx, msg.data
                     )
+            elif msg.op == OSD_OP_OMAPGET:
+                # omap replicates on every replica/shard: serve local
+                kv = self.store.omap_get_vals(
+                    pg.cid, store_oid,
+                    start_after=msg.attr,
+                    max_return=msg.length,
+                )
+                e = Encoder()
+                e.map(
+                    kv,
+                    lambda e2, k: e2.string(k),
+                    lambda e2, v: e2.bytes(v),
+                )
+                reply.data = e.getvalue()
             elif msg.op == OSD_OP_LIST:
                 reply.names = sorted(
                     o[len(OBJ_PREFIX):]
@@ -790,6 +817,12 @@ class OSD(Dispatcher):
                 f"{cls_name}.{method} failed: {type(e).__name__}: {e}"
             )
 
+    def _omap_of(self, pg: PG, store_oid: str) -> dict[str, bytes]:
+        try:
+            return self.store.omap_get(pg.cid, store_oid)
+        except StoreError:
+            return {}
+
     def _cls_ctx(self, pg: PG, store_oid: str) -> MethodContext:
         exists = self.store.exists(pg.cid, store_oid)
         attrs = {}
@@ -801,19 +834,22 @@ class OSD(Dispatcher):
                 ).items()
                 if k.startswith("c_")
             }
+        omap_fn = lambda: self._omap_of(pg, store_oid)  # noqa: E731
         if self._is_ec(pg):
-            # class attrs replicate on every shard, so the local read
-            # above stands; the DATA read must decode across shards
+            # class attrs and omap replicate on every shard, so the
+            # local reads stand; the DATA read decodes across shards
             ecs = self._ec_store_for(pg)
             return MethodContext(
                 read_fn=lambda: ecs.get(store_oid),
                 attrs=attrs,
                 exists=exists,
+                omap_fn=omap_fn,
             )
         return MethodContext(
             read_fn=lambda: self.store.read(pg.cid, store_oid),
             attrs=attrs,
             exists=exists,
+            omap_fn=omap_fn,
         )
 
     def _mutate(self, pg: PG, epoch: int, msg: MOSDOp, store_oid: str):
@@ -878,6 +914,19 @@ class OSD(Dispatcher):
         elif msg.op == OSD_OP_SETXATTR:
             txn.touch(pg.cid, store_oid)
             txn.setattr(pg.cid, store_oid, "u_" + msg.attr, msg.data)
+        elif msg.op == OSD_OP_OMAPSET:
+            kv = Decoder(msg.data).map(
+                lambda d: d.string(), lambda d: d.bytes()
+            )
+            txn.touch(pg.cid, store_oid)
+            txn.omap_setkeys(pg.cid, store_oid, kv)
+        elif msg.op == OSD_OP_OMAPRM:
+            keys = Decoder(msg.data).list(lambda d: d.string())
+            txn.touch(pg.cid, store_oid)
+            txn.omap_rmkeys(pg.cid, store_oid, keys)
+        elif msg.op == OSD_OP_OMAPCLEAR:
+            txn.touch(pg.cid, store_oid)
+            txn.omap_clear(pg.cid, store_oid)
         elif msg.op == OSD_OP_CALL:
             # fold the staged mutations into THIS logged, replicated
             # transaction (do_osd_ops CEPH_OSD_OP_CALL)
@@ -886,27 +935,41 @@ class OSD(Dispatcher):
                     txn.remove(pg.cid, store_oid)
             else:
                 surviving: dict[str, bytes] = {}
+                surviving_omap: dict[str, bytes] = {}
                 if ctx.new_data is not None:
                     if existed:
                         # a rewrite must not destroy the object's
-                        # OTHER attrs (user xattrs included) —
+                        # OTHER attrs or its omap —
                         # cls_cxx_write_full keeps them
                         surviving = self.store.list_attrs(
                             pg.cid, store_oid
                         )
+                        surviving_omap = self._omap_of(pg, store_oid)
                         txn.remove(pg.cid, store_oid)
                     txn.touch(pg.cid, store_oid)
                     if ctx.new_data:
                         txn.write(pg.cid, store_oid, 0, ctx.new_data)
-                elif not existed:
+                else:
+                    # idempotent: the same txn must apply on a lagging
+                    # replica that does not hold the object yet
                     txn.touch(pg.cid, store_oid)
                 for k, v in surviving.items():
                     if not (
                         k.startswith("c_") and k[2:] in ctx.new_attrs
                     ):
                         txn.setattr(pg.cid, store_oid, k, v)
+                if surviving_omap:
+                    txn.omap_setkeys(
+                        pg.cid, store_oid, surviving_omap
+                    )
                 for k, v in ctx.new_attrs.items():
                     txn.setattr(pg.cid, store_oid, "c_" + k, v)
+                if ctx.rm_omap:
+                    txn.omap_rmkeys(
+                        pg.cid, store_oid, sorted(ctx.rm_omap)
+                    )
+                if ctx.new_omap:
+                    txn.omap_setkeys(pg.cid, store_oid, ctx.new_omap)
         elif msg.op == OSD_OP_DELETE:
             txn.remove(pg.cid, store_oid)
         txn_by_osd = {
@@ -1085,6 +1148,28 @@ class OSD(Dispatcher):
                 encode_all(b"", {"u_" + msg.attr: msg.data})
         elif msg.op == OSD_OP_DELETE:
             remove_all()
+        elif msg.op in (OSD_OP_OMAPSET, OSD_OP_OMAPRM, OSD_OP_OMAPCLEAR):
+            # omap replicates identically on every shard (attr-like);
+            # an omap write on a fresh object first creates the empty
+            # encoded object so meta/stat stay coherent
+            if not existed:
+                if msg.op != OSD_OP_OMAPSET:
+                    raise StoreError(f"no object {msg.oid} (-ENOENT)")
+                encode_all(b"")
+            for pos, _osd in present:
+                txn = txns.setdefault(
+                    pos, Transaction().touch(pg.cid, store_oid)
+                )
+                if msg.op == OSD_OP_OMAPSET:
+                    kv = Decoder(msg.data).map(
+                        lambda d: d.string(), lambda d: d.bytes()
+                    )
+                    txn.omap_setkeys(pg.cid, store_oid, kv)
+                elif msg.op == OSD_OP_OMAPRM:
+                    keys = Decoder(msg.data).list(lambda d: d.string())
+                    txn.omap_rmkeys(pg.cid, store_oid, keys)
+                else:
+                    txn.omap_clear(pg.cid, store_oid)
         elif msg.op == OSD_OP_CALL:
             if ctx.removed:
                 if existed:
@@ -1095,7 +1180,8 @@ class OSD(Dispatcher):
                 }
                 if ctx.new_data is not None:
                     # shard rewrites truncate in place, so the object's
-                    # other attrs survive (cls_cxx_write_full keeps them)
+                    # other attrs and omap survive (cls_cxx_write_full
+                    # keeps them)
                     encode_all(ctx.new_data, new_attrs)
                 elif new_attrs and existed:
                     for pos, _osd in present:
@@ -1105,6 +1191,20 @@ class OSD(Dispatcher):
                         txns[pos] = txn
                 elif not existed:
                     encode_all(b"", new_attrs)
+                if ctx.rm_omap or ctx.new_omap:
+                    for pos, _osd in present:
+                        txn = txns.setdefault(
+                            pos,
+                            Transaction().touch(pg.cid, store_oid),
+                        )
+                        if ctx.rm_omap:
+                            txn.omap_rmkeys(
+                                pg.cid, store_oid, sorted(ctx.rm_omap)
+                            )
+                        if ctx.new_omap:
+                            txn.omap_setkeys(
+                                pg.cid, store_oid, ctx.new_omap
+                            )
         else:
             raise StoreError(f"op {msg.op} unsupported on EC (-EOPNOTSUPP)")
 

@@ -53,6 +53,8 @@ class UnreachableStore(ObjectStore):
     list_objects = _fail
     list_collections = _fail
     list_attrs = _fail
+    omap_get = _fail
+    omap_get_vals = _fail
 
 
 class ECCodec:

@@ -17,6 +17,7 @@ from __future__ import annotations
 import concurrent.futures
 import json
 
+from ..common.encoding import Decoder, Encoder
 from ..mon.monitor import MonClient
 from ..msg import Messenger
 from ..msg.message import (
@@ -25,6 +26,10 @@ from ..msg.message import (
     OSD_OP_DELETE,
     OSD_OP_GETXATTR,
     OSD_OP_LIST,
+    OSD_OP_OMAPCLEAR,
+    OSD_OP_OMAPGET,
+    OSD_OP_OMAPRM,
+    OSD_OP_OMAPSET,
     OSD_OP_READ,
     OSD_OP_SETXATTR,
     OSD_OP_STAT,
@@ -161,6 +166,41 @@ class IoCtx:
             self.pool_id, oid, OSD_OP_GETXATTR, attr=name
         )
         return reply.data
+
+    # -- omap (rados_omap_* / IoCtxImpl omap ops) --------------------------
+    def omap_set(self, oid: str, kv: dict[str, bytes]) -> None:
+        e = Encoder()
+        e.map(
+            kv,
+            lambda e2, k: e2.string(k),
+            lambda e2, v: e2.bytes(bytes(v)),
+        )
+        self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_OMAPSET, data=e.getvalue()
+        )
+
+    def omap_get_vals(
+        self, oid: str, start_after: str = "", max_return: int = -1
+    ) -> dict[str, bytes]:
+        reply = self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_OMAPGET,
+            attr=start_after, length=max_return,
+        )
+        return Decoder(reply.data).map(
+            lambda d: d.string(), lambda d: d.bytes()
+        )
+
+    def omap_rm_keys(self, oid: str, keys) -> None:
+        e = Encoder()
+        e.list(list(keys), lambda e2, k: e2.string(k))
+        self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_OMAPRM, data=e.getvalue()
+        )
+
+    def omap_clear(self, oid: str) -> None:
+        self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_OMAPCLEAR
+        )
 
     def execute(
         self, oid: str, cls: str, method: str, indata: bytes = b""

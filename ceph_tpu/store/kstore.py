@@ -42,7 +42,8 @@ from .objectstore import (
 
 _SNAP = "snap.bin"
 _WAL = "wal.log"
-_SNAP_MAGIC = 0x4B53544F  # "KSTO"
+_SNAP_MAGIC = 0x4B53544F  # "KSTO" (v1: data + xattrs)
+_SNAP_MAGIC_V2 = 0x4B535432  # "KST2" (v2: + omap)
 
 
 class KStore(MemStore):
@@ -125,7 +126,7 @@ class KStore(MemStore):
     # -- snapshot format ---------------------------------------------------
     def _snapshot(self) -> bytes:
         e = Encoder()
-        e.u32(_SNAP_MAGIC)
+        e.u32(_SNAP_MAGIC_V2)
         e.u32(len(self._colls))
         for cid in sorted(self._colls):
             e.string(cid)
@@ -137,6 +138,11 @@ class KStore(MemStore):
                 e.bytes(bytes(obj.data))
                 e.map(
                     obj.xattrs,
+                    lambda e2, k: e2.string(k),
+                    lambda e2, v: e2.bytes(v),
+                )
+                e.map(
+                    obj.omap,
                     lambda e2, k: e2.string(k),
                     lambda e2, v: e2.bytes(v),
                 )
@@ -161,7 +167,7 @@ class KStore(MemStore):
 
         if len(body) >= 4 and int.from_bytes(
             body[:4], "little"
-        ) == _SNAP_MAGIC:
+        ) in (_SNAP_MAGIC, _SNAP_MAGIC_V2):
             # legacy pre-compression snapshot: magic-first, raw body
             pass
         else:
@@ -176,8 +182,10 @@ class KStore(MemStore):
             except (CompressorError, UnicodeDecodeError) as e:
                 raise DecodeError(f"snapshot decompress: {e}")
         d = Decoder(body)
-        if d.u32() != _SNAP_MAGIC:
+        magic = d.u32()
+        if magic not in (_SNAP_MAGIC, _SNAP_MAGIC_V2):
             raise DecodeError("bad snapshot magic")
+        has_omap = magic == _SNAP_MAGIC_V2
         for _ in range(d.u32()):
             cid = d.string()
             coll: dict = {}
@@ -188,6 +196,10 @@ class KStore(MemStore):
                 obj.xattrs = d.map(
                     lambda d2: d2.string(), lambda d2: d2.bytes()
                 )
+                if has_omap:
+                    obj.omap = d.map(
+                        lambda d2: d2.string(), lambda d2: d2.bytes()
+                    )
                 coll[oid] = obj
             self._colls[cid] = coll
 

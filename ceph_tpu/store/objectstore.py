@@ -25,6 +25,10 @@ class StoreError(Exception):
 class _Object:
     data: bytearray = field(default_factory=bytearray)
     xattrs: dict[str, bytes] = field(default_factory=dict)
+    # the omap: a sorted key→value namespace separate from xattrs
+    # (ObjectStore.h:687 omap_get and siblings; BlueStore keeps it in
+    # RocksDB — the index-style workload surface cls_log/rgw build on)
+    omap: dict[str, bytes] = field(default_factory=dict)
 
 
 class Transaction:
@@ -61,6 +65,21 @@ class Transaction:
         self.ops.append(("remove", cid, oid))
         return self
 
+    def omap_setkeys(self, cid: str, oid: str, kv: dict[str, bytes]):
+        self.ops.append(
+            ("omap_setkeys", cid, oid,
+             {k: bytes(v) for k, v in kv.items()})
+        )
+        return self
+
+    def omap_rmkeys(self, cid: str, oid: str, keys):
+        self.ops.append(("omap_rmkeys", cid, oid, list(keys)))
+        return self
+
+    def omap_clear(self, cid: str, oid: str):
+        self.ops.append(("omap_clear", cid, oid))
+        return self
+
     def remove_collection(self, cid: str):
         self.ops.append(("rmcoll", cid, None))
         return self
@@ -92,6 +111,21 @@ class ObjectStore:
         raise NotImplementedError
 
     def list_attrs(self, cid: str, oid: str) -> dict[str, bytes]:
+        raise NotImplementedError
+
+    def omap_get(self, cid: str, oid: str) -> dict[str, bytes]:
+        """Whole omap (ObjectStore::omap_get)."""
+        raise NotImplementedError
+
+    def omap_get_vals(
+        self,
+        cid: str,
+        oid: str,
+        start_after: str = "",
+        max_return: int = -1,
+    ) -> dict[str, bytes]:
+        """Key-ordered page after ``start_after``
+        (ObjectStore::omap_get_values + iterator paging)."""
         raise NotImplementedError
 
 
@@ -221,6 +255,24 @@ class MemStore(ObjectStore):
             if obj is None:
                 raise StoreError(f"no object {cid}/{oid} (-ENOENT)")
             st.objects[(cid, oid)] = None
+        elif kind == "omap_setkeys":
+            _, _, _, kv = op
+            obj = st.get(cid, oid)
+            if obj is None:
+                raise StoreError(f"no object {cid}/{oid} (-ENOENT)")
+            obj.omap.update(kv)
+        elif kind == "omap_rmkeys":
+            _, _, _, keys = op
+            obj = st.get(cid, oid)
+            if obj is None:
+                raise StoreError(f"no object {cid}/{oid} (-ENOENT)")
+            for k in keys:
+                obj.omap.pop(k, None)
+        elif kind == "omap_clear":
+            obj = st.get(cid, oid)
+            if obj is None:
+                raise StoreError(f"no object {cid}/{oid} (-ENOENT)")
+            obj.omap.clear()
         else:
             raise StoreError(f"unknown op {kind}")
 
@@ -273,6 +325,24 @@ class MemStore(ObjectStore):
                 raise StoreError(f"no collection {cid} (-ENOENT)")
             return sorted(self._colls[cid])
 
+    def omap_get(self, cid, oid) -> dict[str, bytes]:
+        with self._lock:
+            return dict(self._get(cid, oid).omap)
+
+    def omap_get_vals(
+        self, cid, oid, start_after: str = "", max_return: int = -1
+    ) -> dict[str, bytes]:
+        with self._lock:
+            omap = self._get(cid, oid).omap
+            out: dict[str, bytes] = {}
+            for k in sorted(omap):
+                if k <= start_after and start_after:
+                    continue
+                out[k] = omap[k]
+                if 0 <= max_return <= len(out):
+                    break
+            return out
+
 
 # -- transaction serialization ---------------------------------------------
 # (Transaction.h's op encoding role; lives here rather than the
@@ -287,9 +357,27 @@ _TXN_OPS = {
     "rmattr": "csss",
     "remove": "css",
     "rmcoll": "cs",
+    "omap_setkeys": "cssm",
+    "omap_rmkeys": "cssL",
+    "omap_clear": "css",
 }
-# field codes: c=opcode string, s=str, q=int, b=bytes
-_OPCODES = {name: i for i, name in enumerate(sorted(_TXN_OPS))}
+# field codes: c=opcode string, s=str, q=int, b=bytes,
+# m=str→bytes map, L=str list
+# opcodes are EXPLICIT and append-only: they are a durable format
+# (the KStore WAL frames transactions with them)
+_OPCODES = {
+    "mkcoll": 0,
+    "remove": 1,
+    "rmattr": 2,
+    "rmcoll": 3,
+    "setattr": 4,
+    "touch": 5,
+    "truncate": 6,
+    "write": 7,
+    "omap_setkeys": 8,
+    "omap_rmkeys": 9,
+    "omap_clear": 10,
+}
 _OPNAMES = {i: name for name, i in _OPCODES.items()}
 
 
@@ -307,6 +395,14 @@ def encode_transaction(e: Encoder, txn: Transaction) -> None:
                 e.s64(val)
             elif kind == "b":
                 e.bytes(val)
+            elif kind == "m":
+                e.map(
+                    val,
+                    lambda e2, k: e2.string(k),
+                    lambda e2, v: e2.bytes(v),
+                )
+            elif kind == "L":
+                e.list(val, lambda e2, s: e2.string(s))
 
 
 def decode_transaction(d: Decoder) -> Transaction:
@@ -322,6 +418,12 @@ def decode_transaction(d: Decoder) -> Transaction:
                 args.append(d.s64())
             elif kind == "b":
                 args.append(d.bytes())
+            elif kind == "m":
+                args.append(
+                    d.map(lambda d2: d2.string(), lambda d2: d2.bytes())
+                )
+            elif kind == "L":
+                args.append(d.list(lambda d2: d2.string()))
         if name in ("mkcoll", "rmcoll"):
             args = args[:1]  # stored as (op, cid, None)
             txn.ops.append((name, args[0], None))

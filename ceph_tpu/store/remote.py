@@ -42,6 +42,7 @@ from ..msg.message import (
     READ_DATA,
     READ_EXISTS,
     READ_LIST,
+    READ_OMAP,
     READ_STAT,
 )
 from ..msg.messenger import Connection, Dispatcher
@@ -108,6 +109,14 @@ class ShardServer(Dispatcher):
             e = Encoder()
             e.map(
                 s.list_attrs(cid, oid),
+                lambda e2, k: e2.string(k),
+                lambda e2, v: e2.bytes(v),
+            )
+            return e.getvalue()
+        if kind == READ_OMAP:
+            e = Encoder()
+            e.map(
+                s.omap_get(cid, oid),
                 lambda e2, k: e2.string(k),
                 lambda e2, v: e2.bytes(v),
             )
@@ -190,6 +199,12 @@ class RemoteStore(ObjectStore):
 
     def list_attrs(self, cid, oid) -> dict[str, bytes]:
         raw = self._one(READ_ATTRS, cid, oid)
+        return Decoder(raw).map(
+            lambda d: d.string(), lambda d: d.bytes()
+        )
+
+    def omap_get(self, cid, oid) -> dict[str, bytes]:
+        raw = self._one(READ_OMAP, cid, oid)
         return Decoder(raw).map(
             lambda d: d.string(), lambda d: d.bytes()
         )

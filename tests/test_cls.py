@@ -106,11 +106,11 @@ def test_execute_end_to_end(cluster):
         with pytest.raises(RadosError):
             io.execute("obj", "lock", "lock",
                        json.dumps({"cookie": "c2"}).encode())
-        # log class appends + lists
+        # log class appends + lists (omap-backed entries)
         io.execute("events", "log", "add", b"first")
         io.execute("events", "log", "add", b"second")
-        lines = io.execute("events", "log", "list").splitlines()
-        assert [json.loads(l)["entry"] for l in lines] == [
+        entries = json.loads(io.execute("events", "log", "list"))
+        assert [e["entry"] for e in entries] == [
             "first", "second",
         ]
         with pytest.raises(RadosError):

@@ -1,0 +1,297 @@
+"""omap end-to-end — Transaction/ObjectStore/KStore persistence,
+replication + recovery through the daemon, librados surface, and the
+omap-backed cls_log (src/os/ObjectStore.h:687 omap_get and siblings,
+src/cls/log/cls_log.cc)."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ceph_tpu.common.encoding import Decoder, Encoder
+from ceph_tpu.store.kstore import KStore
+from ceph_tpu.store.objectstore import (
+    MemStore,
+    StoreError,
+    Transaction,
+    decode_transaction,
+    encode_transaction,
+)
+
+from test_osd_daemon import MiniCluster, POOL
+from ceph_tpu.osd.daemon import OBJ_PREFIX
+from ceph_tpu.rados import Rados
+
+CID = "c"
+
+
+def _mk(store):
+    store.queue_transaction(Transaction().create_collection(CID))
+
+
+def test_memstore_omap_ops_and_paging():
+    s = MemStore()
+    _mk(s)
+    s.queue_transaction(
+        Transaction()
+        .touch(CID, "o")
+        .omap_setkeys(CID, "o", {"b": b"2", "a": b"1", "c": b"3"})
+    )
+    assert s.omap_get(CID, "o") == {"a": b"1", "b": b"2", "c": b"3"}
+    # paging is key-ordered and start_after-exclusive
+    assert s.omap_get_vals(CID, "o", start_after="a") == {
+        "b": b"2", "c": b"3",
+    }
+    assert s.omap_get_vals(CID, "o", max_return=2) == {
+        "a": b"1", "b": b"2",
+    }
+    s.queue_transaction(Transaction().omap_rmkeys(CID, "o", ["b", "zz"]))
+    assert sorted(s.omap_get(CID, "o")) == ["a", "c"]
+    s.queue_transaction(Transaction().omap_clear(CID, "o"))
+    assert s.omap_get(CID, "o") == {}
+    # omap ops on a missing object are -ENOENT, atomically
+    with pytest.raises(StoreError):
+        s.queue_transaction(
+            Transaction().omap_setkeys(CID, "nope", {"k": b"v"})
+        )
+    # a failing op later in the txn rolls the omap write back too
+    with pytest.raises(StoreError):
+        s.queue_transaction(
+            Transaction()
+            .omap_setkeys(CID, "o", {"x": b"y"})
+            .remove(CID, "missing")
+        )
+    assert s.omap_get(CID, "o") == {}
+
+
+def test_transaction_codec_roundtrip_with_omap():
+    txn = (
+        Transaction()
+        .touch(CID, "o")
+        .omap_setkeys(CID, "o", {"k1": b"v1", "k2": b"\x00\xff"})
+        .omap_rmkeys(CID, "o", ["k1"])
+        .omap_clear(CID, "o")
+        .write(CID, "o", 0, b"data")
+    )
+    e = Encoder()
+    encode_transaction(e, txn)
+    back = decode_transaction(Decoder(e.getvalue()))
+    assert back.ops == txn.ops
+
+
+def test_kstore_omap_survives_remount(tmp_path):
+    path = tmp_path / "ks"
+    s = KStore(path)
+    _mk(s)
+    s.queue_transaction(
+        Transaction().touch(CID, "o").omap_setkeys(
+            CID, "o", {"k": b"v", "j": b"w"}
+        )
+    )
+    s.compact()  # snapshot path
+    s.queue_transaction(
+        Transaction().omap_rmkeys(CID, "o", ["j"]).omap_setkeys(
+            CID, "o", {"post": b"snap"}
+        )
+    )
+    s.close()  # WAL replay path on top of the snapshot
+    s2 = KStore(path)
+    assert s2.omap_get(CID, "o") == {"k": b"v", "post": b"snap"}
+    s2.close()
+
+
+_CRASH_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+from ceph_tpu.store.kstore import KStore
+from ceph_tpu.store.objectstore import Transaction
+s = KStore({path!r})
+try:
+    s.queue_transaction(Transaction().create_collection("c"))
+except Exception:
+    pass
+s.queue_transaction(
+    Transaction().touch("c", "o").omap_setkeys(
+        "c", "o", {{"durable": b"yes"}}
+    )
+)
+print("committed", flush=True)
+os.kill(os.getpid(), 9)  # no close, no compact: WAL only
+"""
+
+
+def test_kstore_omap_survives_sigkill(tmp_path):
+    path = str(tmp_path / "crash")
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         _CRASH_SCRIPT.format(repo=os.getcwd(), path=path)],
+        stdout=subprocess.PIPE,
+    )
+    out, _ = proc.communicate(timeout=60)
+    assert b"committed" in out
+    assert proc.returncode == -signal.SIGKILL
+    s = KStore(path)
+    assert s.omap_get("c", "o") == {"durable": b"yes"}
+    s.close()
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster()
+    for i in range(3):
+        c.start_osd(i)
+    c.wait_active()
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def rados_client(cluster):
+    r = Rados("omap-test").connect(*cluster.mon_addr)
+    r.mon_command(
+        {"prefix": "osd pool create", "pool": "omappool",
+         "pg_num": 2, "size": 3}
+    )
+    try:
+        yield r
+    finally:
+        r.shutdown()
+
+
+def test_omap_through_librados(rados_client):
+    io = rados_client.open_ioctx("omappool")
+    io.write_full("obj", b"payload")
+    io.omap_set("obj", {"k1": b"v1", "k2": b"v2", "k3": b"v3"})
+    assert io.omap_get_vals("obj") == {
+        "k1": b"v1", "k2": b"v2", "k3": b"v3",
+    }
+    assert io.omap_get_vals("obj", start_after="k1", max_return=1) == {
+        "k2": b"v2",
+    }
+    io.omap_rm_keys("obj", ["k2"])
+    assert sorted(io.omap_get_vals("obj")) == ["k1", "k3"]
+    # omap on a fresh object auto-creates it (rados semantics)
+    io.omap_set("fresh", {"only": b"omap"})
+    assert io.omap_get_vals("fresh") == {"only": b"omap"}
+    io.omap_clear("obj")
+    assert io.omap_get_vals("obj") == {}
+    # data untouched by omap ops
+    assert io.read("obj") == b"payload"
+
+
+def test_omap_replicates_and_recovers(cluster, rados_client):
+    """omap rides the logged transaction to every replica and the
+    recovery push to a revived OSD."""
+    io = rados_client.open_ioctx("omappool")
+    io.write_full("rec", b"x")
+    io.omap_set("rec", {"pre": b"kill"})
+    # every replica holds the omap
+    pool_id = rados_client.pool_lookup("omappool")
+    pgid = None
+    for osd in cluster.osds.values():
+        for pg in osd.pgs.values():
+            if pg.pool_id == pool_id and osd.store.exists(
+                pg.cid, OBJ_PREFIX + "rec"
+            ):
+                assert osd.store.omap_get(
+                    pg.cid, OBJ_PREFIX + "rec"
+                ) == {"pre": b"kill"}
+                pgid = pg.pgid
+    assert pgid is not None
+    # kill an OSD, write more omap, revive: recovery must deliver it
+    victim = next(
+        o for o, osd in cluster.osds.items()
+        if pgid in osd.pgs and osd.pgs[pgid].primary != o
+    )
+    store = cluster.osds[victim].store
+    cluster.kill_osd(victim)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if not rados_client.monc.osdmap.is_up(victim):
+            break
+        time.sleep(0.1)
+    io.omap_set("rec", {"while": b"down"})
+    cluster.start_osd(victim, store=store)
+    deadline = time.monotonic() + 20
+    got = {}
+    while time.monotonic() < deadline:
+        try:
+            got = store.omap_get(f"pg_{pgid}", OBJ_PREFIX + "rec")
+        except StoreError:
+            got = {}
+        if "while" in got:
+            break
+        time.sleep(0.2)
+    assert got == {"pre": b"kill", "while": b"down"}, got
+
+
+def test_omap_on_erasure_pool(cluster, rados_client):
+    """omap replicates attr-like onto every EC shard and serves
+    through the same client surface."""
+    rc, _outb, outs = rados_client.mon_command(
+        {
+            "prefix": "osd erasure-code-profile set",
+            "name": "omap_ec",
+            "profile": ["k=2", "m=1", "plugin=jerasure"],
+        }
+    )
+    assert rc == 0, outs
+    rados_client.pool_create(
+        "ecomap", pool_type=3, pg_num=2,
+        erasure_code_profile="omap_ec", min_size=2,
+    )
+    io = rados_client.open_ioctx("ecomap")
+    io.write_full("eo", b"sharded")
+    io.omap_set("eo", {"idx": b"1", "jdx": b"2"})
+    assert io.omap_get_vals("eo") == {"idx": b"1", "jdx": b"2"}
+    io.omap_rm_keys("eo", ["jdx"])
+    assert io.omap_get_vals("eo") == {"idx": b"1"}
+    assert io.read("eo") == b"sharded"
+    # every shard holds the omap copy
+    pool_id = rados_client.pool_lookup("ecomap")
+    holders = 0
+    for osd in cluster.osds.values():
+        for pg in osd.pgs.values():
+            if pg.pool_id == pool_id and osd.store.exists(
+                pg.cid, OBJ_PREFIX + "eo"
+            ):
+                assert osd.store.omap_get(
+                    pg.cid, OBJ_PREFIX + "eo"
+                ) == {"idx": b"1"}
+                holders += 1
+    assert holders == 3  # k+m shards
+
+
+def test_cls_log_omap_backed(rados_client):
+    """cls_log stores entries as omap keys, lists in time order, and
+    trims by count — through the full librados execute path."""
+    io = rados_client.open_ioctx("omappool")
+    for i in range(5):
+        io.execute("logobj", "log", "add", f"entry-{i}".encode())
+    out = json.loads(io.execute("logobj", "log", "list"))
+    assert [e["entry"] for e in out] == [
+        f"entry-{i}" for i in range(5)
+    ]
+    # entries live in real omap keys
+    assert len(io.omap_get_vals("logobj")) == 5
+    # paged list
+    page = json.loads(
+        io.execute(
+            "logobj", "log", "list",
+            json.dumps({"from": out[1]["key"], "max": 2}).encode(),
+        )
+    )
+    assert [e["entry"] for e in page] == ["entry-2", "entry-3"]
+    # trim to the newest 2
+    io.execute("logobj", "log", "trim", b"2")
+    out = json.loads(io.execute("logobj", "log", "list"))
+    assert [e["entry"] for e in out] == ["entry-3", "entry-4"]
+    assert len(io.omap_get_vals("logobj")) == 2
