@@ -937,6 +937,21 @@ def _batched(cm: CompiledMap, ruleno: int, result_max: int):
     return jax.jit(jax.vmap(fn, in_axes=(0, None)))
 
 
+@functools.lru_cache(maxsize=64)
+def _batched_range(cm: CompiledMap, ruleno: int, result_max: int, n: int):
+    """Jitted contiguous-range variant: xs = lo + iota(n) is built ON
+    DEVICE, so a bulk remap (osdmaptool --test-map-pgs shape) ships
+    one scalar per call instead of an N-element host array, and calls
+    pipeline without host round-trips between dispatches."""
+    fn = _make_rule_fn(cm, ruleno, result_max)
+
+    def run(lo, wv):
+        xs = lo + jnp.arange(n, dtype=jnp.int32)
+        return jax.vmap(fn, in_axes=(0, None))(xs, wv)
+
+    return jax.jit(run)
+
+
 def batch_do_rule(
     cm: CompiledMap,
     ruleno: int,
@@ -952,3 +967,23 @@ def batch_do_rule(
     xs = jnp.asarray(xs, dtype=jnp.int32)
     wv = jnp.asarray(weights, dtype=jnp.int32)
     return _batched(cm, ruleno, result_max)(xs, wv)
+
+
+def batch_do_rule_range(
+    cm: CompiledMap,
+    ruleno: int,
+    lo: int,
+    n: int,
+    result_max: int,
+    weights=None,
+):
+    """Map the contiguous inputs [lo, lo+n): like ``batch_do_rule``
+    but the input range materializes on device and the call returns
+    WITHOUT blocking — callers overlap dispatch with host-side
+    materialization of earlier results (np.asarray when needed)."""
+    if weights is None:
+        weights = np.full(max(cm.max_devices, 1), 0x10000, np.int32)
+    wv = jnp.asarray(weights, dtype=jnp.int32)
+    return _batched_range(cm, ruleno, result_max, n)(
+        jnp.int32(lo), wv
+    )

@@ -1,0 +1,114 @@
+/* Timed single-thread CRUSH baseline: the SAME 10k-OSD straw2
+ * hierarchy bench.py's device path maps (build_hierarchy(10000, 40,
+ * 25): hosts of 40 OSDs, racks of 25 hosts, root; jewel tunables;
+ * rule = TAKE root, CHOOSELEAF_FIRSTN over hosts, EMIT), built with
+ * the reference's builder.c and timed through crush_do_rule
+ * (src/crush/mapper.c:900) — the honest mappings/s denominator for
+ * BENCH's crush_vs_c.
+ *
+ * Compile (bench.py does this at run time):
+ *   gcc -O2 -I <ref>/src tests/data/crush_bench.c \
+ *       <ref>/src/crush/{mapper,builder,crush,hash}.c -lm -o crush_bench
+ * Usage: crush_bench [num_xs]   (default 200000)
+ * Prints: "<num_xs> <seconds> <mappings_per_sec>" and a checksum.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include "crush/crush.h"
+#include "crush/builder.h"
+#include "crush/mapper.h"
+#include "crush/hash.h"
+
+#define NUM_OSDS 10000
+#define PER_HOST 40
+#define HOSTS_PER_RACK 25
+#define NUM_REP 3
+
+int main(int argc, char **argv) {
+    int num_xs = argc > 1 ? atoi(argv[1]) : 200000;
+    struct crush_map *map = crush_create();
+    map->choose_local_tries = 0;
+    map->choose_local_fallback_tries = 0;
+    map->choose_total_tries = 50;
+    map->chooseleaf_descend_once = 1;
+    map->chooseleaf_vary_r = 1;
+    map->chooseleaf_stable = 1;
+
+    int num_hosts = (NUM_OSDS + PER_HOST - 1) / PER_HOST;
+    int *hosts = malloc(sizeof(int) * num_hosts);
+    for (int h = 0; h < num_hosts; h++) {
+        int n = PER_HOST;
+        if ((h + 1) * PER_HOST > NUM_OSDS) n = NUM_OSDS - h * PER_HOST;
+        int items[PER_HOST], weights[PER_HOST];
+        for (int i = 0; i < n; i++) {
+            items[i] = h * PER_HOST + i;
+            weights[i] = 0x10000;
+        }
+        struct crush_bucket *b = crush_make_bucket(map,
+            CRUSH_BUCKET_STRAW2, CRUSH_HASH_RJENKINS1, 1, n, items,
+            weights);
+        int id;
+        crush_add_bucket(map, 0, b, &id);
+        hosts[h] = id;
+    }
+    int num_racks = (num_hosts + HOSTS_PER_RACK - 1) / HOSTS_PER_RACK;
+    int *racks = malloc(sizeof(int) * num_racks);
+    for (int r = 0; r < num_racks; r++) {
+        int n = HOSTS_PER_RACK;
+        if ((r + 1) * HOSTS_PER_RACK > num_hosts)
+            n = num_hosts - r * HOSTS_PER_RACK;
+        int items[HOSTS_PER_RACK], weights[HOSTS_PER_RACK];
+        for (int i = 0; i < n; i++) {
+            items[i] = hosts[r * HOSTS_PER_RACK + i];
+            weights[i] = map->buckets[-1 - items[i]]->weight;
+        }
+        struct crush_bucket *b = crush_make_bucket(map,
+            CRUSH_BUCKET_STRAW2, CRUSH_HASH_RJENKINS1, 2, n, items,
+            weights);
+        int id;
+        crush_add_bucket(map, 0, b, &id);
+        racks[r] = id;
+    }
+    int *rweights = malloc(sizeof(int) * num_racks);
+    for (int r = 0; r < num_racks; r++)
+        rweights[r] = map->buckets[-1 - racks[r]]->weight;
+    struct crush_bucket *rootb = crush_make_bucket(map,
+        CRUSH_BUCKET_STRAW2, CRUSH_HASH_RJENKINS1, 3, num_racks, racks,
+        rweights);
+    int root;
+    crush_add_bucket(map, 0, rootb, &root);
+
+    /* replicated_rule: TAKE root, CHOOSELEAF_FIRSTN 0 host, EMIT */
+    struct crush_rule *rule = crush_make_rule(3, 0, 1, 1, 10);
+    crush_rule_set_step(rule, 0, CRUSH_RULE_TAKE, root, 0);
+    crush_rule_set_step(rule, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1);
+    crush_rule_set_step(rule, 2, CRUSH_RULE_EMIT, 0, 0);
+    crush_add_rule(map, rule, 0);
+    crush_finalize(map);
+
+    __u32 *weight = malloc(sizeof(__u32) * NUM_OSDS);
+    for (int i = 0; i < NUM_OSDS; i++) weight[i] = 0x10000;
+    void *cwin = malloc(crush_work_size(map, NUM_REP));
+    crush_init_workspace(map, cwin);
+
+    int result[NUM_REP];
+    unsigned long checksum = 0;
+    /* warm pass keeps page faults out of the timed loop */
+    for (int x = 0; x < 1000; x++)
+        crush_do_rule(map, 0, x, result, NUM_REP, weight, NUM_OSDS, cwin,
+                      NULL);
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    for (int x = 0; x < num_xs; x++) {
+        int n = crush_do_rule(map, 0, x, result, NUM_REP, weight,
+                              NUM_OSDS, cwin, NULL);
+        for (int i = 0; i < n; i++) checksum += (unsigned)result[i];
+    }
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    double dt = (t1.tv_sec - t0.tv_sec) + (t1.tv_nsec - t0.tv_nsec) / 1e9;
+    printf("%d %.6f %.0f\n", num_xs, dt, num_xs / dt);
+    fprintf(stderr, "checksum %lu\n", checksum);
+    return 0;
+}
