@@ -65,6 +65,9 @@ class MethodContext:
         self.new_omap: dict[str, bytes] = {}
         self.rm_omap: set[str] = set()
         self.removed = False
+        # payloads to deliver to the object's watchers AFTER the op
+        # commits (cls_cxx_notify; cls_lock's unlock broadcast)
+        self.notifies: list[bytes] = []
 
     # -- reads (cls_cxx_read / stat / getxattr) ----------------------------
     def read(self) -> bytes:
@@ -122,6 +125,10 @@ class MethodContext:
     def remove(self) -> None:
         self.removed = True
         self.new_data = None
+
+    def notify(self, payload: bytes) -> None:
+        """Queue a watcher notification delivered once the op commits."""
+        self.notifies.append(bytes(payload))
 
     @property
     def has_staged_writes(self) -> bool:
@@ -229,6 +236,11 @@ def _unlock(ctx: MethodContext, indata: bytes) -> bytes:
     if not state["holders"]:
         state["type"] = ""
     ctx.setxattr(_LOCK_ATTR, json.dumps(state).encode())
+    # waiters watch the object and retry on this broadcast
+    # (cls_lock's unlock → watch/notify wakeup pattern)
+    ctx.notify(
+        json.dumps({"event": "unlocked", "cookie": req["cookie"]}).encode()
+    )
     return b""
 
 

@@ -364,6 +364,62 @@ def _cmd_pool_create(mon: Monitor, cmd: dict) -> MMonCommandReply:
     )
 
 
+def _pool_by_name(mon: Monitor, name: str):
+    for pid, pname in mon.osdmap.pool_names.items():
+        if pname == name:
+            return pid, mon.osdmap.pools[pid]
+    return None, None
+
+
+def _cmd_pool_mksnap(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """"osd pool mksnap" (OSDMonitor::prepare_command pool snaps):
+    bump the pool's snap_seq and record the named snap; the new pool
+    rides an incremental, and every write after this epoch clones."""
+    pid, pool = _pool_by_name(mon, cmd["pool"])
+    if pool is None:
+        return MMonCommandReply(rc=-2, outs=f"pool {cmd['pool']!r} not found")
+    snap = cmd["snap"]
+    if snap in pool.snaps.values():
+        return MMonCommandReply(rc=-17, outs=f"snap {snap!r} exists")
+    import copy as _copy
+
+    newpool = _copy.deepcopy(pool)
+    newpool.snap_seq += 1
+    newpool.snaps[newpool.snap_seq] = snap
+    inc = mon.pending()
+    inc.new_pools[pid] = newpool
+    epoch = mon.commit(inc)
+    return MMonCommandReply(
+        outs=f"created pool {cmd['pool']} snap {snap}",
+        outb=json.dumps(
+            {"snapid": newpool.snap_seq, "epoch": epoch}
+        ),
+    )
+
+
+def _cmd_pool_rmsnap(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    pid, pool = _pool_by_name(mon, cmd["pool"])
+    if pool is None:
+        return MMonCommandReply(rc=-2, outs=f"pool {cmd['pool']!r} not found")
+    snap = cmd["snap"]
+    sid = next(
+        (k for k, v in pool.snaps.items() if v == snap), None
+    )
+    if sid is None:
+        return MMonCommandReply(rc=-2, outs=f"snap {snap!r} not found")
+    import copy as _copy
+
+    newpool = _copy.deepcopy(pool)
+    del newpool.snaps[sid]
+    inc = mon.pending()
+    inc.new_pools[pid] = newpool
+    epoch = mon.commit(inc)
+    return MMonCommandReply(
+        outs=f"removed pool {cmd['pool']} snap {snap}",
+        outb=json.dumps({"snapid": sid, "epoch": epoch}),
+    )
+
+
 def _cmd_pool_delete(mon: Monitor, cmd: dict) -> MMonCommandReply:
     name = cmd["pool"]
     ids = [i for i, n in mon.osdmap.pool_names.items() if n == name]
@@ -558,6 +614,8 @@ _COMMANDS = {
     "osd dump": _cmd_osd_dump,
     "osd pool create": _cmd_pool_create,
     "osd pool delete": _cmd_pool_delete,
+    "osd pool mksnap": _cmd_pool_mksnap,
+    "osd pool rmsnap": _cmd_pool_rmsnap,
     "osd erasure-code-profile set": _cmd_ec_profile_set,
     "osd erasure-code-profile get": _cmd_ec_profile_get,
     "osd erasure-code-profile ls": _cmd_ec_profile_ls,

@@ -43,6 +43,8 @@ from .message import (
     MPGPushReply,
     MPGQuery,
     MPing,
+    MWatchNotify,
+    MWatchNotifyAck,
     Message,
     MessageError,
     register_message,
@@ -72,6 +74,8 @@ __all__ = [
     "MPGPushReply",
     "MPGQuery",
     "MPing",
+    "MWatchNotify",
+    "MWatchNotifyAck",
     "Message",
     "MessageError",
     "Messenger",

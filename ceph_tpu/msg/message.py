@@ -371,6 +371,9 @@ OSD_OP_OMAPSET = 10  # data = encoded {key: value} map
 OSD_OP_OMAPGET = 11  # attr = start_after, length = max_return
 OSD_OP_OMAPRM = 12  # data = encoded [key] list
 OSD_OP_OMAPCLEAR = 13
+OSD_OP_WATCH = 14  # offset = client cookie
+OSD_OP_UNWATCH = 15  # offset = client cookie
+OSD_OP_NOTIFY = 16  # data = payload; reply.data = encoded ack list
 
 
 @register_message
@@ -391,12 +394,13 @@ class MOSDOp(Message):
     attr: str = ""
     reqid: str = ""  # stable across retries (osd_reqid_t role)
     epoch: int = 0  # client's map epoch (primary checks staleness)
+    snapid: int = 0  # read snapshot (0 = head, CEPH_NOSNAP role)
 
     def encode_payload(self, e: Encoder) -> None:
         e.s64(self.pool).string(self.pgid).string(self.oid)
         e.u8(self.op).u64(self.offset).s64(self.length)
         e.bytes(self.data).string(self.attr).string(self.reqid)
-        e.u32(self.epoch)
+        e.u32(self.epoch).u64(self.snapid)
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MOSDOp":
@@ -404,7 +408,7 @@ class MOSDOp(Message):
             pool=d.s64(), pgid=d.string(), oid=d.string(),
             op=d.u8(), offset=d.u64(), length=d.s64(),
             data=d.bytes(), attr=d.string(), reqid=d.string(),
-            epoch=d.u32(),
+            epoch=d.u32(), snapid=d.u64(),
         )
 
 
@@ -618,6 +622,49 @@ class MPGPushReply(Message):
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MPGPushReply":
         return cls(from_osd=d.s32(), ok=d.bool())
+
+
+@register_message
+@dataclass
+class MWatchNotify(Message):
+    """OSD → watcher: a notify fired on an object you watch
+    (MWatchNotify); the client acks with MWatchNotifyAck carrying the
+    same notify_id."""
+
+    TYPE = 26
+    oid: str = ""
+    notify_id: int = 0
+    cookie: int = 0  # the watcher's registration cookie
+    payload: bytes = b""
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.oid).u64(self.notify_id).u64(self.cookie)
+        e.bytes(self.payload)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MWatchNotify":
+        return cls(
+            oid=d.string(), notify_id=d.u64(), cookie=d.u64(),
+            payload=d.bytes(),
+        )
+
+
+@register_message
+@dataclass
+class MWatchNotifyAck(Message):
+    TYPE = 27
+    notify_id: int = 0
+    cookie: int = 0
+    reply: bytes = b""
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.u64(self.notify_id).u64(self.cookie).bytes(self.reply)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MWatchNotifyAck":
+        return cls(
+            notify_id=d.u64(), cookie=d.u64(), reply=d.bytes()
+        )
 
 
 # election ops (Elector.cc / ElectionLogic.cc roles)

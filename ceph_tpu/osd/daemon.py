@@ -51,10 +51,12 @@ build_pg_backend split (src/osd/PGBackend.cc:571-607):
 
 from __future__ import annotations
 
+import itertools
 import json
 import queue
 import threading
 import time
+import types
 
 from ..common.encoding import Decoder, Encoder
 from ..crush.types import CRUSH_ITEM_NONE
@@ -85,6 +87,7 @@ from ..msg.message import (
     OSD_OP_DELETE,
     OSD_OP_GETXATTR,
     OSD_OP_LIST,
+    OSD_OP_NOTIFY,
     OSD_OP_OMAPCLEAR,
     OSD_OP_OMAPGET,
     OSD_OP_OMAPRM,
@@ -92,8 +95,12 @@ from ..msg.message import (
     OSD_OP_READ,
     OSD_OP_SETXATTR,
     OSD_OP_STAT,
+    OSD_OP_UNWATCH,
+    OSD_OP_WATCH,
     OSD_OP_WRITE,
     OSD_OP_WRITEFULL,
+    MWatchNotify,
+    MWatchNotifyAck,
 )
 from ..msg.messenger import Connection, Dispatcher
 from ..cls import RD as CLS_RD, WR as CLS_WR, ClassError, MethodContext, default_handler
@@ -118,6 +125,11 @@ PG_META = "_pgmeta_"
 LOG_PREFIX = "_log/"
 OBJ_PREFIX = "o_"
 INFO_ATTR = "pginfo"
+# snapshots: clones are stored as "<OBJ_PREFIX><oid>@<snapid>" (the
+# clone-object naming of hobject_t snaps); "@" is reserved in oids.
+# "sn_born" records the pool snap_seq at object creation so reads at
+# snaps older than the object's birth resolve to -ENOENT.
+BORN_ATTR = "sn_born"
 
 
 def _log_oid(version: tuple[int, int]) -> str:
@@ -202,6 +214,14 @@ class OSD(Dispatcher):
         # (the handle_sub_read/handle_sub_write role)
         self._ec_codecs: dict[tuple, ECCodec] = {}
         self._shard_server = ShardServer(self.store, whoami)
+        # watch/notify (PrimaryLogPG watchers + Notify machinery):
+        # watchers are in-memory per primary — clients re-register via
+        # Objecter linger on every new interval (documented deviation
+        # from the reference's object_info-persisted watch records)
+        self._watchers: dict[tuple[str, str], dict[int, Connection]] = {}
+        self._watch_lock = threading.Lock()
+        self._notify_seq = itertools.count(1)
+        self._notify_pending: dict[int, dict] = {}
         self.log_keep = 128  # pg_log length bound (osd_min_pg_log_entries role)
         self.class_handler = default_handler  # ClassHandler role
         self.addr: tuple[str, int] | None = None
@@ -338,6 +358,18 @@ class OSD(Dispatcher):
                         pg.activated_epoch = 0
                     pg.state = "replica"
                     pg.peered_interval = interval
+        # snap trimming: clones stranded by removed pool snaps go
+        # through the same logged-delete path as client removals
+        with self._pg_lock:
+            primaries = [
+                pg for pg in self.pgs.values()
+                if pg.primary == self.whoami and pg.state == "active"
+            ]
+        for pg in primaries:
+            try:
+                self._trim_snaps(pg)
+            except StoreError:
+                pass
 
     def _ensure_coll(self, pg: PG) -> None:
         try:
@@ -744,6 +776,13 @@ class OSD(Dispatcher):
         store_oid = OBJ_PREFIX + msg.oid
         is_ec = self._is_ec(pg)
         try:
+            if msg.op in (
+                OSD_OP_READ, OSD_OP_STAT, OSD_OP_GETXATTR
+            ) and msg.snapid:
+                # reads at a snap serve from the covering clone
+                store_oid = self._resolve_snap_read(
+                    pg, msg.oid, msg.snapid
+                )
             if msg.op == OSD_OP_READ:
                 if is_ec:
                     whole = self._ec_store_for(pg).get(store_oid)
@@ -766,6 +805,11 @@ class OSD(Dispatcher):
                 reply.data = self.store.getattr(
                     pg.cid, store_oid, "u_" + msg.attr
                 )
+            elif msg.op in (OSD_OP_WATCH, OSD_OP_UNWATCH):
+                self._handle_watch(pg, conn, msg)
+            elif msg.op == OSD_OP_NOTIFY:
+                acks = self._notify_watchers(pg, msg.oid, msg.data)
+                reply.data = json.dumps(acks).encode()
             elif msg.op == OSD_OP_CALL:
                 cls_name, _, method = msg.attr.partition(".")
                 flags = self.class_handler.flags_of(cls_name, method)
@@ -791,10 +835,11 @@ class OSD(Dispatcher):
                 )
                 reply.data = e.getvalue()
             elif msg.op == OSD_OP_LIST:
+                # heads only: snap clones ("@"-suffixed) stay hidden
                 reply.names = sorted(
                     o[len(OBJ_PREFIX):]
                     for o in self.store.list_objects(pg.cid)
-                    if o.startswith(OBJ_PREFIX)
+                    if o.startswith(OBJ_PREFIX) and "@" not in o
                 )
             else:
                 self._mutate(pg, epoch, msg, store_oid)
@@ -822,6 +867,213 @@ class OSD(Dispatcher):
             return self.store.omap_get(pg.cid, store_oid)
         except StoreError:
             return {}
+
+    # -- snapshots (make_writeable / SnapSet resolution) -------------------
+    def _born_at(self, pg: PG, store_oid: str) -> int:
+        try:
+            return int(
+                self.store.getattr(pg.cid, store_oid, BORN_ATTR)
+            )
+        except (StoreError, ValueError):
+            return 0
+
+    def _maybe_clone(
+        self, pg: PG, epoch: int, oid: str, existed: bool
+    ) -> None:
+        """Clone-on-first-write-after-snap (PrimaryLogPG::
+        make_writeable): before a mutation lands on an object that
+        predates the pool's newest snap, preserve the head as
+        "<oid>@<snap_seq>" — ONE store-local clone op riding a logged
+        transaction of its own, so clones replicate, recover, and
+        reconstruct exactly like any object on both backends."""
+        pool = self._pool_of(pg)
+        snapc = pool.snap_seq if pool is not None else 0
+        if not existed or snapc <= 0:
+            return
+        head = OBJ_PREFIX + oid
+        clone_store = OBJ_PREFIX + f"{oid}@{snapc}"
+        if self.store.exists(pg.cid, clone_store):
+            return  # already preserved for this snap context
+        if self._born_at(pg, head) >= snapc:
+            return  # object born after the newest snap: nothing owed
+        txn = Transaction().clone(pg.cid, head, clone_store)
+        pg.seq += 1
+        entry = LogEntry(
+            op=MODIFY,
+            oid=f"{oid}@{snapc}",
+            version=(epoch, pg.seq),
+            reqid="",
+            prior_version=EV_ZERO,
+        )
+        targets = {
+            osd: txn
+            for osd in pg.acting
+            if osd != CRUSH_ITEM_NONE
+            and (osd == self.whoami or self.monc.osdmap.is_up(osd))
+        }
+        self._commit_and_replicate(
+            pg, epoch, types.SimpleNamespace(reqid=""), entry,
+            targets, b"",
+        )
+
+    def _resolve_snap_read(self, pg: PG, oid: str, snapid: int) -> str:
+        """Map (oid, snapid) to the store object serving that snap:
+        the oldest clone whose id >= snapid, else the head — provided
+        the serving object was born BEFORE the snap (SnapSet clone
+        lookup, PrimaryLogPG::find_object_context)."""
+        head = OBJ_PREFIX + oid
+        if snapid <= 0:
+            return head
+        pool = self._pool_of(pg)
+        live = sorted(s for s in (pool.snaps if pool else {}) if s >= snapid)
+        for c in live:
+            clone_store = OBJ_PREFIX + f"{oid}@{c}"
+            if self.store.exists(pg.cid, clone_store):
+                if self._born_at(pg, clone_store) >= snapid:
+                    break  # born after the snap: didn't exist then
+                return clone_store
+        if (
+            self.store.exists(pg.cid, head)
+            and self._born_at(pg, head) < snapid
+        ):
+            return head
+        raise StoreError(
+            f"no object {oid} at snap {snapid} (-ENOENT)"
+        )
+
+    def _trim_snaps(self, pg: PG, limit: int = 32) -> None:
+        """Remove clones stranded by deleted pool snaps (the snap
+        trimmer role): a clone @c is removable once no live snap falls
+        in the interval it covers, (next-lower clone or birth, c]."""
+        if pg.primary != self.whoami or pg.state != "active":
+            return
+        pool = self._pool_of(pg)
+        if pool is None:
+            return
+        live = set(pool.snaps)
+        epoch = self.monc.epoch
+        try:
+            names = self.store.list_objects(pg.cid)
+        except StoreError:
+            return
+        clones: dict[str, list[int]] = {}
+        for n in names:
+            if not n.startswith(OBJ_PREFIX) or "@" not in n:
+                continue
+            base, _, c = n[len(OBJ_PREFIX):].rpartition("@")
+            try:
+                clones.setdefault(base, []).append(int(c))
+            except ValueError:
+                continue
+        done = 0
+        for base, ids in clones.items():
+            ids.sort()
+            for i, c in enumerate(ids):
+                if c in live:
+                    continue
+                clone_store = OBJ_PREFIX + f"{base}@{c}"
+                lower = ids[i - 1] if i else self._born_at(
+                    pg, clone_store
+                )
+                if any(lower < s <= c for s in live):
+                    continue  # still serves a live snap
+                txn = (
+                    Transaction()
+                    .touch(pg.cid, clone_store)
+                    .remove(pg.cid, clone_store)
+                )
+                pg.seq += 1
+                entry = LogEntry(
+                    op=DELETE,
+                    oid=f"{base}@{c}",
+                    version=(epoch, pg.seq),
+                    reqid="",
+                    prior_version=(1, 0),
+                )
+                targets = {
+                    osd: txn
+                    for osd in pg.acting
+                    if osd != CRUSH_ITEM_NONE
+                    and (
+                        osd == self.whoami
+                        or self.monc.osdmap.is_up(osd)
+                    )
+                }
+                try:
+                    self._commit_and_replicate(
+                        pg, epoch,
+                        types.SimpleNamespace(reqid=""), entry,
+                        targets, b"",
+                    )
+                except StoreError:
+                    return
+                done += 1
+                if done >= limit:
+                    return
+
+    # -- watch/notify (PrimaryLogPG watchers / Notify) ---------------------
+    def _handle_watch(self, pg: PG, conn: Connection, msg: MOSDOp):
+        key = (pg.pgid, msg.oid)
+        with self._watch_lock:
+            if msg.op == OSD_OP_WATCH:
+                self._watchers.setdefault(key, {})[msg.offset] = conn
+            else:
+                watchers = self._watchers.get(key, {})
+                watchers.pop(msg.offset, None)
+                if not watchers:
+                    self._watchers.pop(key, None)
+
+    def _notify_watchers(
+        self, pg: PG, oid: str, payload: bytes, timeout: float = 2.0
+    ) -> list[dict]:
+        """Fan a notify to every watcher and gather acks (Notify's
+        completion gathering with a timeout for dead watchers)."""
+        key = (pg.pgid, oid)
+        with self._watch_lock:
+            watchers = dict(self._watchers.get(key, {}))
+        if not watchers:
+            return []
+        notify_id = next(self._notify_seq)
+        state = {
+            "want": set(watchers),
+            "acks": {},
+            "event": threading.Event(),
+        }
+        self._notify_pending[notify_id] = state
+        for cookie, conn in watchers.items():
+            try:
+                conn.send(
+                    MWatchNotify(
+                        tid=self.messenger.new_tid(),
+                        oid=oid, notify_id=notify_id,
+                        cookie=cookie, payload=payload,
+                    )
+                )
+            except (MessageError, OSError):
+                state["want"].discard(cookie)
+                with self._watch_lock:
+                    self._watchers.get(key, {}).pop(cookie, None)
+        if state["want"] and timeout > 0:
+            state["event"].wait(timeout)
+        self._notify_pending.pop(notify_id, None)
+        return [
+            {
+                "cookie": cookie,
+                "acked": cookie in state["acks"],
+                "reply": state["acks"].get(cookie, b"").decode(
+                    "latin-1"
+                ),
+            }
+            for cookie in watchers
+        ]
+
+    def _handle_notify_ack(self, msg: MWatchNotifyAck) -> None:
+        state = self._notify_pending.get(msg.notify_id)
+        if state is None:
+            return
+        state["acks"][msg.cookie] = msg.reply
+        if set(state["acks"]) >= state["want"]:
+            state["event"].set()
 
     def _cls_ctx(self, pg: PG, store_oid: str) -> MethodContext:
         exists = self.store.exists(pg.cid, store_oid)
@@ -869,6 +1121,9 @@ class OSD(Dispatcher):
             # only the SAME client op retried is idempotent; a fresh
             # delete of a missing object is -ENOENT (rados semantics)
             raise StoreError(f"no object {msg.oid} (-ENOENT)")
+        # snap context: preserve the pre-mutation head if the pool has
+        # a snap this object has not been cloned for (make_writeable)
+        self._maybe_clone(pg, epoch, msg.oid, existed)
         ctx = None
         outdata = b""
         if msg.op == OSD_OP_CALL:
@@ -972,14 +1227,31 @@ class OSD(Dispatcher):
                     txn.omap_setkeys(pg.cid, store_oid, ctx.new_omap)
         elif msg.op == OSD_OP_DELETE:
             txn.remove(pg.cid, store_oid)
+        if (
+            not existed
+            and msg.op != OSD_OP_DELETE
+            and not (ctx is not None and ctx.removed)
+        ):
+            # birth stamp: reads at snaps older than creation resolve
+            # to -ENOENT (the clone/head born-before-snap check)
+            pool = self._pool_of(pg)
+            txn.setattr(
+                pg.cid, store_oid, BORN_ATTR,
+                str(pool.snap_seq if pool else 0).encode(),
+            )
         txn_by_osd = {
             osd: txn
             for osd in pg.acting
             if osd != CRUSH_ITEM_NONE
         }
-        return self._commit_and_replicate(
+        out = self._commit_and_replicate(
             pg, epoch, msg, entry, txn_by_osd, outdata
         )
+        if ctx is not None:
+            for payload in ctx.notifies:
+                # post-commit, fire-and-forget (cls_cxx_notify)
+                self._notify_watchers(pg, msg.oid, payload, timeout=0)
+        return out
 
     def _commit_and_replicate(
         self,
@@ -1085,6 +1357,10 @@ class OSD(Dispatcher):
         existed = old_meta is not None
         if msg.op == OSD_OP_DELETE and not existed:
             raise StoreError(f"no object {msg.oid} (-ENOENT)")
+        # snap context (make_writeable): the clone op copies each
+        # position's LOCAL shard, so one logged txn preserves the
+        # erasure-coded head too
+        self._maybe_clone(pg, epoch, msg.oid, existed)
         ctx = None
         outdata = b""
         if msg.op == OSD_OP_CALL:
@@ -1207,6 +1483,17 @@ class OSD(Dispatcher):
                             )
         else:
             raise StoreError(f"op {msg.op} unsupported on EC (-EOPNOTSUPP)")
+        if (
+            not existed
+            and msg.op != OSD_OP_DELETE
+            and not (ctx is not None and ctx.removed)
+        ):
+            born = str(pool.snap_seq if pool else 0).encode()
+            for pos, _osd in present:
+                txn = txns.setdefault(
+                    pos, Transaction().touch(pg.cid, store_oid)
+                )
+                txn.setattr(pg.cid, store_oid, BORN_ATTR, born)
 
         pg.seq += 1
         version = (epoch, pg.seq)
@@ -1223,9 +1510,13 @@ class OSD(Dispatcher):
             osd: txns.setdefault(pos, Transaction())
             for pos, osd in present
         }
-        return self._commit_and_replicate(
+        out = self._commit_and_replicate(
             pg, epoch, msg, entry, txn_by_osd, outdata
         )
+        if ctx is not None:
+            for payload in ctx.notifies:
+                self._notify_watchers(pg, msg.oid, payload, timeout=0)
+        return out
 
     def _maybe_trim(self, pg: PG) -> None:
         """Bound the pg log (PGLog::trim), removing the trimmed
@@ -1441,6 +1732,9 @@ class OSD(Dispatcher):
             # shard-side sub-op service (handle_sub_read/-write,
             # ECBackend.cc:934,1010): pure store access, serve inline
             return self._shard_server.ms_dispatch(conn, msg)
+        if isinstance(msg, MWatchNotifyAck):
+            self._handle_notify_ack(msg)
+            return True
         if isinstance(msg, MPGPush):
             self._handle_push(conn, msg)
             return True
@@ -1466,6 +1760,18 @@ class OSD(Dispatcher):
                 )
             return True
         return False
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        """A dead client connection takes its watches with it
+        (watch_disconnect_t without the grace timer)."""
+        with self._watch_lock:
+            for key in list(self._watchers):
+                watchers = self._watchers[key]
+                for cookie, c in list(watchers.items()):
+                    if c is conn:
+                        del watchers[cookie]
+                if not watchers:
+                    del self._watchers[key]
 
     # -- worker / ticker ---------------------------------------------------
     def _work_loop(self) -> None:

@@ -78,6 +78,10 @@ class PgPool:
     erasure_code_profile: str = ""
     hashpspool: bool = True
     last_change: int = 0  # epoch of last pool modification
+    # pool snapshots (pg_pool_t::snaps / snap_seq): snap id → name;
+    # snap_seq is the newest snap id, the write path's snap context
+    snap_seq: int = 0
+    snaps: dict[int, str] = field(default_factory=dict)
 
     def __post_init__(self):
         if not self.pgp_num:
@@ -743,6 +747,12 @@ def _enc_pool(e: Encoder, p: PgPool) -> None:
     e.u32(p.pg_num).u32(p.pgp_num).u32(p.crush_rule)
     e.string(p.erasure_code_profile).bool(p.hashpspool)
     e.u32(p.last_change)
+    e.u64(p.snap_seq)
+    e.map(
+        p.snaps,
+        lambda e2, k: e2.u64(k),
+        lambda e2, v: e2.string(v),
+    )
 
 
 def _dec_pool(d: Decoder) -> PgPool:
@@ -757,6 +767,8 @@ def _dec_pool(d: Decoder) -> PgPool:
         erasure_code_profile=d.string(),
         hashpspool=d.bool(),
         last_change=d.u32(),
+        snap_seq=d.u64(),
+        snaps=d.map(lambda d2: d2.u64(), lambda d2: d2.string()),
     )
 
 

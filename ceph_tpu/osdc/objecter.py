@@ -52,6 +52,33 @@ class Objecter:
         # deduped by the primary (append idempotency)
         self._client_id = os.urandom(6).hex()
         self._op_seq = itertools.count(1)
+        # linger ops (Objecter::linger_watch): watches re-registered
+        # on every map change so a new primary learns the watchers
+        self._lingers: dict[int, tuple[int, str]] = {}  # cookie → (pool, oid)
+        self._linger_epoch = 0
+
+    # -- linger (watch re-registration) ------------------------------------
+    def linger_register(self, cookie: int, pool_id: int, oid: str):
+        self._lingers[cookie] = (pool_id, oid)
+
+    def linger_unregister(self, cookie: int) -> None:
+        self._lingers.pop(cookie, None)
+
+    def handle_map_change(self, epoch: int) -> None:
+        """Re-send WATCH for every linger (the watch re-registration
+        after an interval change; watchers are primary-resident)."""
+        from ..msg.message import OSD_OP_WATCH
+
+        if epoch <= self._linger_epoch:
+            return
+        self._linger_epoch = epoch
+        for cookie, (pool_id, oid) in list(self._lingers.items()):
+            try:
+                self.op_submit(
+                    pool_id, oid, OSD_OP_WATCH, offset=cookie
+                )
+            except RadosError:
+                pass  # next epoch retries
 
     # -- targeting ---------------------------------------------------------
     def _target(self, pool_id: int, oid: str) -> tuple[str, int]:
@@ -89,6 +116,7 @@ class Objecter:
         data: bytes = b"",
         attr: str = "",
         pgid: str | None = None,
+        snapid: int = 0,
     ) -> MOSDOpReply:
         """Target, send, and retry until acked or timed out."""
         deadline = time.monotonic() + self.op_timeout
@@ -108,6 +136,7 @@ class Objecter:
                         pool=pool_id, pgid=tgt_pgid, oid=oid, op=op,
                         offset=offset, length=length, data=data,
                         attr=attr, reqid=reqid, epoch=self.monc.epoch,
+                        snapid=snapid,
                     ),
                     timeout=min(5.0, self.op_timeout),
                 )

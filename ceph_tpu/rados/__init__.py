@@ -26,6 +26,7 @@ from ..msg.message import (
     OSD_OP_DELETE,
     OSD_OP_GETXATTR,
     OSD_OP_LIST,
+    OSD_OP_NOTIFY,
     OSD_OP_OMAPCLEAR,
     OSD_OP_OMAPGET,
     OSD_OP_OMAPRM,
@@ -33,6 +34,8 @@ from ..msg.message import (
     OSD_OP_READ,
     OSD_OP_SETXATTR,
     OSD_OP_STAT,
+    OSD_OP_UNWATCH,
+    OSD_OP_WATCH,
     OSD_OP_WRITE,
     OSD_OP_WRITEFULL,
 )
@@ -51,12 +54,24 @@ class Rados:
 
     def __init__(self, name: str = "client"):
         self.messenger = Messenger(name)
-        self.monc = MonClient(self.messenger, whoami=-1)
+        self.monc = MonClient(
+            self.messenger, on_map=self._on_map, whoami=-1
+        )
         self.objecter = Objecter(self.monc, self.messenger)
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=4, thread_name_prefix=f"{name}.aio"
         )
         self._connected = False
+        # watch callbacks by cookie (librados watch handles)
+        self._watch_cbs: dict[int, object] = {}
+        self._watch_seq = __import__("itertools").count(1)
+        self.messenger.add_dispatcher(_WatchDispatcher(self))
+
+    def _on_map(self, epoch: int) -> None:
+        # linger re-registration does blocking RPC — never on the
+        # messenger loop thread (the map push arrives there)
+        if self.objecter._lingers:
+            self._pool.submit(self.objecter.handle_map_change, epoch)
 
     def connect(self, mon_host: str, mon_port: int) -> "Rados":
         self.monc.connect(mon_host, mon_port)
@@ -110,12 +125,54 @@ class Rados:
         return IoCtx(self, self.pool_lookup(pool_name))
 
 
+class _WatchDispatcher:
+    """Client-side MWatchNotify delivery: run the watch callback off
+    the loop thread and ack (the librados watch callback contract)."""
+
+    def __init__(self, rados: "Rados"):
+        self.rados = rados
+
+    def ms_dispatch(self, conn, msg) -> bool:
+        from ..msg import MWatchNotify, MWatchNotifyAck
+
+        if not isinstance(msg, MWatchNotify):
+            return False
+        cb = self.rados._watch_cbs.get(msg.cookie)
+
+        def deliver():
+            reply = b""
+            if cb is not None:
+                try:
+                    reply = cb(msg.payload) or b""
+                except Exception:  # noqa: BLE001 — user callback
+                    reply = b""
+            try:
+                conn.send(
+                    MWatchNotifyAck(
+                        tid=self.rados.messenger.new_tid(),
+                        notify_id=msg.notify_id,
+                        cookie=msg.cookie,
+                        reply=bytes(reply),
+                    )
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
+        self.rados._pool.submit(deliver)
+        return True
+
+    def ms_handle_reset(self, conn) -> None:
+        pass
+
+
 class IoCtx:
     """Per-pool I/O handle (rados_ioctx_t / IoCtxImpl)."""
 
     def __init__(self, rados: Rados, pool_id: int):
         self.rados = rados
         self.pool_id = pool_id
+        # read snapshot context (rados_ioctx_snap_set_read): 0 = head
+        self.read_snap = 0
 
     # -- sync data ops -----------------------------------------------------
     def write_full(self, oid: str, data: bytes) -> None:
@@ -139,7 +196,8 @@ class IoCtx:
 
     def read(self, oid: str, length: int = -1, offset: int = 0) -> bytes:
         reply = self.rados.objecter.op_submit(
-            self.pool_id, oid, OSD_OP_READ, offset=offset, length=length
+            self.pool_id, oid, OSD_OP_READ, offset=offset,
+            length=length, snapid=self.read_snap,
         )
         return reply.data
 
@@ -150,9 +208,79 @@ class IoCtx:
 
     def stat(self, oid: str) -> int:
         reply = self.rados.objecter.op_submit(
-            self.pool_id, oid, OSD_OP_STAT
+            self.pool_id, oid, OSD_OP_STAT, snapid=self.read_snap
         )
         return reply.size
+
+    # -- pool snapshots (rados_ioctx_snap_*) -------------------------------
+    def _pool(self):
+        return self.rados.monc.osdmap.pools[self.pool_id]
+
+    def snap_create(self, name: str) -> int:
+        pool_name = self.rados.monc.osdmap.pool_names[self.pool_id]
+        reply = self.rados.monc.command(
+            {"prefix": "osd pool mksnap", "pool": pool_name,
+             "snap": name}
+        )
+        if reply.rc != 0:
+            raise RadosError(reply.outs)
+        out = json.loads(reply.outb)
+        self.rados.monc.wait_for_epoch(out["epoch"])
+        return out["snapid"]
+
+    def snap_remove(self, name: str) -> None:
+        pool_name = self.rados.monc.osdmap.pool_names[self.pool_id]
+        reply = self.rados.monc.command(
+            {"prefix": "osd pool rmsnap", "pool": pool_name,
+             "snap": name}
+        )
+        if reply.rc != 0:
+            raise RadosError(reply.outs)
+        self.rados.monc.wait_for_epoch(json.loads(reply.outb)["epoch"])
+
+    def snap_list(self) -> dict[int, str]:
+        return dict(self._pool().snaps)
+
+    def snap_lookup(self, name: str) -> int:
+        for sid, sname in self._pool().snaps.items():
+            if sname == name:
+                return sid
+        raise RadosError(f"snap {name!r} not found (-ENOENT)")
+
+    def snap_set_read(self, snap: int | str) -> None:
+        """Route subsequent reads through a snapshot (0/"" = head)."""
+        if isinstance(snap, str):
+            snap = self.snap_lookup(snap) if snap else 0
+        self.read_snap = int(snap)
+
+    # -- watch/notify (rados_watch3 / rados_notify2) -----------------------
+    def watch(self, oid: str, callback) -> int:
+        """Register ``callback(payload) -> reply_bytes|None`` and
+        return the watch handle (cookie).  The watch lingers: it is
+        re-registered on every map change."""
+        cookie = next(self.rados._watch_seq)
+        self.rados._watch_cbs[cookie] = callback
+        self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_WATCH, offset=cookie
+        )
+        self.rados.objecter.linger_register(
+            cookie, self.pool_id, oid
+        )
+        return cookie
+
+    def unwatch(self, oid: str, cookie: int) -> None:
+        self.rados.objecter.linger_unregister(cookie)
+        self.rados._watch_cbs.pop(cookie, None)
+        self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_UNWATCH, offset=cookie
+        )
+
+    def notify(self, oid: str, payload: bytes = b"") -> list[dict]:
+        """Notify every watcher; returns their ack records."""
+        reply = self.rados.objecter.op_submit(
+            self.pool_id, oid, OSD_OP_NOTIFY, data=bytes(payload)
+        )
+        return json.loads(reply.data) if reply.data else []
 
     # -- xattrs ------------------------------------------------------------
     def set_xattr(self, oid: str, name: str, value: bytes) -> None:
@@ -163,7 +291,8 @@ class IoCtx:
 
     def get_xattr(self, oid: str, name: str) -> bytes:
         reply = self.rados.objecter.op_submit(
-            self.pool_id, oid, OSD_OP_GETXATTR, attr=name
+            self.pool_id, oid, OSD_OP_GETXATTR, attr=name,
+            snapid=self.read_snap,
         )
         return reply.data
 

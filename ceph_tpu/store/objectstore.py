@@ -80,6 +80,13 @@ class Transaction:
         self.ops.append(("omap_clear", cid, oid))
         return self
 
+    def clone(self, cid: str, src_oid: str, dst_oid: str):
+        """Copy src's data+xattrs+omap over dst (Transaction::clone —
+        the make_writeable snap-clone primitive; each replica/shard
+        clones its own LOCAL object, so no bytes ride the wire)."""
+        self.ops.append(("clone", cid, src_oid, dst_oid))
+        return self
+
     def remove_collection(self, cid: str):
         self.ops.append(("rmcoll", cid, None))
         return self
@@ -273,6 +280,19 @@ class MemStore(ObjectStore):
             if obj is None:
                 raise StoreError(f"no object {cid}/{oid} (-ENOENT)")
             obj.omap.clear()
+        elif kind == "clone":
+            _, _, src_oid, dst_oid = op
+            src = st.get(cid, src_oid)
+            if src is None:
+                raise StoreError(
+                    f"no object {cid}/{src_oid} (-ENOENT)"
+                )
+            dst = _Object(
+                data=bytearray(src.data),
+                xattrs=dict(src.xattrs),
+                omap=dict(src.omap),
+            )
+            st.objects[(cid, dst_oid)] = dst
         else:
             raise StoreError(f"unknown op {kind}")
 
@@ -360,6 +380,7 @@ _TXN_OPS = {
     "omap_setkeys": "cssm",
     "omap_rmkeys": "cssL",
     "omap_clear": "css",
+    "clone": "csss",
 }
 # field codes: c=opcode string, s=str, q=int, b=bytes,
 # m=str→bytes map, L=str list
@@ -377,6 +398,7 @@ _OPCODES = {
     "omap_setkeys": 8,
     "omap_rmkeys": 9,
     "omap_clear": 10,
+    "clone": 11,
 }
 _OPNAMES = {i: name for name, i in _OPCODES.items()}
 

@@ -1,0 +1,231 @@
+"""Pool snapshots (clone-on-write, read-at-snap, trim) and
+watch/notify across the mini-cluster (PrimaryLogPG::make_writeable /
+find_object_context; watch/notify + Objecter linger;
+src/cls/lock unlock broadcast)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.osd.daemon import OBJ_PREFIX
+from ceph_tpu.rados import Rados, RadosError
+
+from test_osd_daemon import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster()
+    for i in range(3):
+        c.start_osd(i)
+    c.wait_active()
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    r = Rados("snap-test").connect(*cluster.mon_addr)
+    r.pool_create("snappool", pg_num=2, size=3)
+    try:
+        yield r
+    finally:
+        r.shutdown()
+
+
+def test_snapshot_then_overwrite_reads_back_old_data(client):
+    io = client.open_ioctx("snappool")
+    io.write_full("doc", b"version-1")
+    io.set_xattr("doc", "rev", b"1")
+    s1 = io.snap_create("s1")
+    io.write_full("doc", b"version-2 is longer")
+    io.set_xattr("doc", "rev", b"2")
+    # head reads the new data
+    assert io.read("doc") == b"version-2 is longer"
+    assert io.get_xattr("doc", "rev") == b"2"
+    # the snap reads the preserved clone
+    io.snap_set_read("s1")
+    assert io.read("doc") == b"version-1"
+    assert io.stat("doc") == len(b"version-1")
+    assert io.get_xattr("doc", "rev") == b"1"
+    io.snap_set_read(0)
+    # second snap, partial overwrite
+    s2 = io.snap_create("s2")
+    io.write("doc", b"XX", offset=0)
+    io.snap_set_read(s2)
+    assert io.read("doc") == b"version-2 is longer"
+    io.snap_set_read(s1)
+    assert io.read("doc") == b"version-1"
+    io.snap_set_read(0)
+    assert io.read("doc")[:2] == b"XX"
+    assert sorted(io.snap_list().values()) == ["s1", "s2"]
+
+
+def test_snapshot_survives_delete_and_birth_gates_reads(client):
+    io = client.open_ioctx("snappool")
+    io.write_full("mort", b"alive")
+    sid = io.snap_create("s3")
+    io.remove("mort")
+    with pytest.raises(Exception):
+        io.read("mort")
+    # the pre-delete state is still readable at the snap
+    io.snap_set_read("s3")
+    assert io.read("mort") == b"alive"
+    io.snap_set_read(0)
+    # an object born AFTER a snap does not exist at that snap
+    io.write_full("newborn", b"fresh")
+    io.snap_set_read("s3")
+    with pytest.raises(Exception):
+        io.read("newborn")
+    io.snap_set_read(0)
+    assert io.read("newborn") == b"fresh"
+    # clones never leak into listings
+    assert not [n for n in io.list_objects() if "@" in n]
+
+
+def test_snap_clones_replicate(cluster, client):
+    """The clone rides the logged transaction: every replica holds it."""
+    io = client.open_ioctx("snappool")
+    io.write_full("repl", b"snapshot me")
+    io.snap_create("s4")
+    io.write_full("repl", b"overwritten")
+    sid = io.snap_lookup("s4")
+    pool_id = client.pool_lookup("snappool")
+    holders = 0
+    for osd in cluster.osds.values():
+        for pg in osd.pgs.values():
+            if pg.pool_id != pool_id:
+                continue
+            clone = OBJ_PREFIX + f"repl@{sid}"
+            if osd.store.exists(pg.cid, clone):
+                assert osd.store.read(pg.cid, clone) == b"snapshot me"
+                holders += 1
+    assert holders == 3, holders
+
+
+def test_snap_trim_removes_stranded_clones(cluster, client):
+    io = client.open_ioctx("snappool")
+    io.write_full("trimme", b"old state")
+    io.snap_create("s5")
+    io.write_full("trimme", b"new state")
+    sid = io.snap_lookup("s5")
+    pool_id = client.pool_lookup("snappool")
+    clone = OBJ_PREFIX + f"trimme@{sid}"
+
+    def clone_count():
+        n = 0
+        for osd in cluster.osds.values():
+            for pg in osd.pgs.values():
+                if pg.pool_id == pool_id and osd.store.exists(
+                    pg.cid, clone
+                ):
+                    n += 1
+        return n
+
+    assert clone_count() == 3
+    io.snap_remove("s5")
+    deadline = time.monotonic() + 15
+    while clone_count() > 0 and time.monotonic() < deadline:
+        time.sleep(0.2)
+    assert clone_count() == 0, "snap trimmer never removed the clone"
+    assert io.read("trimme") == b"new state"
+
+
+def test_watch_notify_across_cluster(cluster, client):
+    watcher = Rados("watcher").connect(*cluster.mon_addr)
+    try:
+        wio = watcher.open_ioctx("snappool")
+        io = client.open_ioctx("snappool")
+        io.write_full("bell", b"x")
+        got = []
+        ready = threading.Event()
+
+        def on_notify(payload):
+            got.append(payload)
+            ready.set()
+            return b"heard:" + payload
+
+        cookie = wio.watch("bell", on_notify)
+        acks = io.notify("bell", b"ding")
+        assert ready.wait(5.0), "watcher never saw the notify"
+        assert got == [b"ding"]
+        assert len(acks) == 1 and acks[0]["acked"]
+        assert acks[0]["reply"] == "heard:ding"
+        # unwatch: no further delivery
+        wio.unwatch("bell", cookie)
+        ready.clear()
+        got.clear()
+        assert io.notify("bell", b"dong") == []
+        assert not ready.wait(0.5)
+    finally:
+        watcher.shutdown()
+
+
+def test_cls_lock_notifies_on_unlock(cluster, client):
+    waiter = Rados("lock-waiter").connect(*cluster.mon_addr)
+    try:
+        wio = waiter.open_ioctx("snappool")
+        io = client.open_ioctx("snappool")
+        io.execute(
+            "mutex", "lock", "lock",
+            json.dumps({"cookie": "holder"}).encode(),
+        )
+        events = []
+        fired = threading.Event()
+
+        def on_unlock(payload):
+            events.append(json.loads(payload))
+            fired.set()
+
+        wio.watch("mutex", on_unlock)
+        # a second locker is refused while held
+        with pytest.raises(RadosError):
+            wio.execute(
+                "mutex", "lock", "lock",
+                json.dumps({"cookie": "waiter"}).encode(),
+            )
+        io.execute(
+            "mutex", "lock", "unlock",
+            json.dumps({"cookie": "holder"}).encode(),
+        )
+        assert fired.wait(5.0), "unlock broadcast never arrived"
+        assert events[0]["event"] == "unlocked"
+        # and now the waiter can take the lock
+        wio.execute(
+            "mutex", "lock", "lock",
+            json.dumps({"cookie": "waiter"}).encode(),
+        )
+    finally:
+        waiter.shutdown()
+
+
+def test_snapshots_on_erasure_pool(cluster, client):
+    """The clone op copies each position's local shard, so EC heads
+    snapshot through the same machinery."""
+    rc, _outb, outs = client.mon_command(
+        {
+            "prefix": "osd erasure-code-profile set",
+            "name": "snap_ec",
+            "profile": ["k=2", "m=1", "plugin=jerasure"],
+        }
+    )
+    assert rc == 0, outs
+    client.pool_create(
+        "ecsnap", pool_type=3, pg_num=2,
+        erasure_code_profile="snap_ec", min_size=2,
+    )
+    io = client.open_ioctx("ecsnap")
+    data1 = b"ec-snapshot-payload " * 400
+    io.write_full("eobj", data1)
+    io.snap_create("es1")
+    io.write_full("eobj", b"replaced entirely")
+    assert io.read("eobj") == b"replaced entirely"
+    io.snap_set_read("es1")
+    assert io.read("eobj") == data1
+    io.snap_set_read(0)
