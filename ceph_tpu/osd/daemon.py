@@ -51,6 +51,7 @@ build_pg_backend split (src/osd/PGBackend.cc:571-607):
 
 from __future__ import annotations
 
+import concurrent.futures
 import itertools
 import json
 import queue
@@ -104,7 +105,9 @@ from ..msg.message import (
 )
 from ..msg.messenger import Connection, Dispatcher
 from ..cls import RD as CLS_RD, WR as CLS_WR, ClassError, MethodContext, default_handler
+from ..common.log import dout
 from ..mon.monitor import MonClient
+from ..native import ceph_crc32c
 from ..store.ec_store import ECStore, HINFO_KEY
 from ..store.objectstore import MemStore, ObjectStore, StoreError, Transaction
 from ..store.remote import RemoteStore, ShardServer
@@ -182,6 +185,10 @@ class PG:
         # erasure pools: cached (key, ECStore, conns) view over the
         # acting set; rebuilt when the interval/up-set/conns change
         self.ec_view: tuple | None = None
+        # scrub scheduling state (PG::ScrubberPasskey stamps,
+        # src/osd/PG.h:231-240): last completed stamp + findings
+        self.last_scrub = 0.0
+        self.scrub_errors: list[dict] = []
 
 
 class OSD(Dispatcher):
@@ -191,7 +198,12 @@ class OSD(Dispatcher):
         store: ObjectStore | None = None,
         tick_interval: float = 0.5,
         heartbeat_grace: float = 2.0,
+        scrub_interval: float = 0.0,
+        recovery_max_active: int = 3,
     ):
+        """``scrub_interval`` > 0 arms tick-driven scrub scheduling
+        (osd_scrub_min_interval); ``recovery_max_active`` caps
+        concurrent recovery pushes (osd_recovery_max_active)."""
         self.whoami = whoami
         self.store = store or MemStore()
         self.messenger = Messenger(f"osd.{whoami}")
@@ -222,6 +234,13 @@ class OSD(Dispatcher):
         self._watch_lock = threading.Lock()
         self._notify_seq = itertools.count(1)
         self._notify_pending: dict[int, dict] = {}
+        # scrub + recovery throttling
+        self.scrub_interval = scrub_interval
+        self.recovery_max_active = max(1, recovery_max_active)
+        self._recovery_active = 0
+        self.recovery_active_peak = 0  # high-water mark (perf gauge)
+        self._recovery_lock = threading.Lock()
+        self._scrubbing: set[str] = set()
         self.log_keep = 128  # pg_log length bound (osd_min_pg_log_entries role)
         self.class_handler = default_handler  # ClassHandler role
         self.addr: tuple[str, int] | None = None
@@ -616,7 +635,15 @@ class OSD(Dispatcher):
         except (MessageError, OSError):
             return False
         is_ec = self._is_ec(pg)
-        for oid, version in missing.items():
+
+        def push_one(oid: str) -> None:
+            """One recovery push under the reservation cap (the
+            RecoveryOp concurrency limit, osd_recovery_max_active)."""
+            with self._recovery_lock:
+                self._recovery_active += 1
+                self.recovery_active_peak = max(
+                    self.recovery_active_peak, self._recovery_active
+                )
             try:
                 if is_ec:
                     pos = pg.acting.index(osd)
@@ -624,11 +651,27 @@ class OSD(Dispatcher):
                 else:
                     push = self._push_for(pg, epoch, oid)
                 conn.call(push)
-            except (MessageError, OSError):
-                return False
-            except (StoreError, ErasureCodeError):
-                # not enough shards to reconstruct right now — leave
-                # this peer unactivated; the tick loop re-peers
+            finally:
+                with self._recovery_lock:
+                    self._recovery_active -= 1
+
+        if missing:
+            ok = True
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.recovery_max_active,
+                thread_name_prefix=f"osd.{self.whoami}.recov",
+            ) as ex:
+                futs = [ex.submit(push_one, oid) for oid in missing]
+                for f in futs:
+                    try:
+                        f.result()
+                    except (MessageError, OSError):
+                        ok = False
+                    except (StoreError, ErasureCodeError):
+                        # not enough shards to reconstruct right now —
+                        # leave the peer unactivated; the tick re-peers
+                        ok = False
+            if not ok:
                 return False
         suffix = [
             _encode_entry(e) for e in pg.log.entries_after(since)
@@ -1761,6 +1804,99 @@ class OSD(Dispatcher):
             return True
         return False
 
+    # -- scrub (PG::scrub via tick, src/osd/PG.h:231-240) ------------------
+    def _scrub_pg(self, pg: PG) -> None:
+        """Scheduled deep scrub: verify every object across the acting
+        set (crc compare on replicated pools; per-shard HashInfo audit
+        through the ECStore view on erasure pools), record findings,
+        stamp completion."""
+        if pg.primary != self.whoami or pg.state != "active":
+            return
+        try:
+            names = [
+                o
+                for o in self.store.list_objects(pg.cid)
+                if o.startswith(OBJ_PREFIX)
+            ]
+        except StoreError:
+            return
+        errors: list[dict] = []
+        osdmap = self.monc.osdmap
+        if self._is_ec(pg):
+            try:
+                ecs = self._ec_store_for(pg)
+            except StoreError:
+                return
+            for name in names:
+                try:
+                    res = ecs.scrub(name)
+                except (ErasureCodeError, StoreError):
+                    continue
+                if res.missing or res.corrupt or res.inconsistent:
+                    errors.append(
+                        {
+                            "oid": name[len(OBJ_PREFIX):],
+                            "missing": list(res.missing),
+                            "corrupt": list(res.corrupt),
+                            "inconsistent": res.inconsistent,
+                        }
+                    )
+        else:
+            peers = {}
+            for osd in pg.acting:
+                if (
+                    osd == self.whoami
+                    or osd == CRUSH_ITEM_NONE
+                    or not osdmap.is_up(osd)
+                ):
+                    continue
+                try:
+                    peers[osd] = RemoteStore(
+                        self._peer_conn(osd), timeout=10.0
+                    )
+                except (MessageError, OSError):
+                    continue
+            for name in names:
+                try:
+                    mine = ceph_crc32c(
+                        0, self.store.read(pg.cid, name)
+                    )
+                except StoreError:
+                    mine = None
+                for osd, rstore in peers.items():
+                    try:
+                        theirs = ceph_crc32c(
+                            0, rstore.read(pg.cid, name)
+                        )
+                    except StoreError:
+                        theirs = None
+                    if theirs != mine:
+                        errors.append(
+                            {
+                                "oid": name[len(OBJ_PREFIX):],
+                                "osd": osd,
+                                "primary_crc": mine,
+                                "peer_crc": theirs,
+                            }
+                        )
+        pg.scrub_errors = errors
+        pg.last_scrub = time.monotonic()
+        txn = Transaction().touch(pg.cid, PG_META)
+        txn.setattr(
+            pg.cid, PG_META, "scrub_stamp",
+            str(time.time()).encode(),
+        )
+        try:
+            self.store.queue_transaction(txn)
+        except StoreError:
+            pass
+        if errors:
+            dout(
+                "osd", 1,
+                f"osd.{self.whoami} pg {pg.pgid} scrub found "
+                f"{len(errors)} inconsistencies",
+            )
+
     def ms_handle_reset(self, conn: Connection) -> None:
         """A dead client connection takes its watches with it
         (watch_disconnect_t without the grace timer)."""
@@ -1789,6 +1925,13 @@ class OSD(Dispatcher):
                     self._apply_activate(item[1], item[2])
                 elif kind == "pull":
                     self._handle_pull(item[1], item[2])
+                elif kind == "scrub":
+                    pg = self.pgs.get(item[1])
+                    try:
+                        if pg is not None:
+                            self._scrub_pg(pg)
+                    finally:
+                        self._scrubbing.discard(item[1])
             except Exception:  # noqa: BLE001 — worker must survive
                 import traceback
 
@@ -1822,6 +1965,21 @@ class OSD(Dispatcher):
                         break
             if retry:
                 self._workq.put(("map", self.monc.epoch))
+            # scheduled scrub: primary PGs past their stamp interval
+            # (OSD::sched_scrub's tick path)
+            if self.scrub_interval > 0:
+                with self._pg_lock:
+                    due = [
+                        pg.pgid
+                        for pg in self.pgs.values()
+                        if pg.primary == self.whoami
+                        and pg.state == "active"
+                        and now - pg.last_scrub > self.scrub_interval
+                        and pg.pgid not in self._scrubbing
+                    ]
+                for pgid in due:
+                    self._scrubbing.add(pgid)
+                    self._workq.put(("scrub", pgid))
             # mon session failover (MonClient reconnect)
             try:
                 self.monc.ensure_connected()

@@ -1,0 +1,189 @@
+"""Scheduled scrub + recovery throttling (PG scrub stamps driven from
+the tick, src/osd/PG.h:231-240 / OSD::sched_scrub; RecoveryOp
+concurrency under osd_recovery_max_active)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.msg.messenger import wait_for
+from ceph_tpu.osd.daemon import OBJ_PREFIX, OSD
+from ceph_tpu.rados import Rados
+
+from test_osd_daemon import MiniCluster
+
+
+def _scrub_cluster():
+    c = MiniCluster()
+    # swap in scrub-armed OSD construction
+    orig = c.start_osd
+
+    def start(i, store=None):
+        osd = OSD(
+            i, store=store, tick_interval=0.2, heartbeat_grace=1.0,
+            scrub_interval=1.0, recovery_max_active=2,
+        )
+        osd.boot(*c.mon_addr)
+        c.osds[i] = osd
+        return osd
+
+    c.start_osd = start
+    for i in range(3):
+        c.start_osd(i)
+    c.wait_active()
+    return c
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = _scrub_cluster()
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    r = Rados("scrub-test").connect(*cluster.mon_addr)
+    r.pool_create("scrubpool", pg_num=2, size=3)
+    try:
+        yield r
+    finally:
+        r.shutdown()
+
+
+def _pg_of(cluster, client, pool, oid):
+    pool_id = client.pool_lookup(pool)
+    for osd in cluster.osds.values():
+        for pg in osd.pgs.values():
+            if (
+                pg.pool_id == pool_id
+                and pg.primary == osd.whoami
+                and osd.store.exists(pg.cid, OBJ_PREFIX + oid)
+            ):
+                return osd, pg
+    return None, None
+
+
+def test_scrub_runs_unprompted_and_stamps(cluster, client):
+    io = client.open_ioctx("scrubpool")
+    io.write_full("clean", b"healthy object")
+    assert wait_for(
+        lambda: all(
+            pg.last_scrub > 0
+            for osd in cluster.osds.values()
+            for pg in osd.pgs.values()
+            if pg.primary == osd.whoami and pg.state == "active"
+        ),
+        15.0,
+    ), "scrub never ran on some primary PG"
+    # a clean cluster scrubs clean
+    for osd in cluster.osds.values():
+        for pg in osd.pgs.values():
+            if pg.primary == osd.whoami:
+                assert pg.scrub_errors == []
+
+
+def test_scrub_finds_planted_corruption(cluster, client):
+    io = client.open_ioctx("scrubpool")
+    io.write_full("victim", b"pristine bytes here")
+    primary_osd, pg = _pg_of(cluster, client, "scrubpool", "victim")
+    assert pg is not None
+    # corrupt a NON-primary replica's copy directly in its store
+    replica = next(
+        o for o in pg.acting if o != primary_osd.whoami
+    )
+    rstore = cluster.osds[replica].store
+    from ceph_tpu.store.objectstore import Transaction
+
+    rstore.queue_transaction(
+        Transaction().write(
+            pg.cid, OBJ_PREFIX + "victim", 0, b"CORRUPTED"
+        )
+    )
+    assert wait_for(
+        lambda: any(
+            e["oid"] == "victim" for e in pg.scrub_errors
+        ),
+        15.0,
+    ), f"scrub never flagged the corruption: {pg.scrub_errors}"
+    err = next(e for e in pg.scrub_errors if e["oid"] == "victim")
+    assert err["osd"] == replica
+
+
+def test_scrub_finds_corrupt_ec_shard(cluster, client):
+    rc, _outb, outs = client.mon_command(
+        {
+            "prefix": "osd erasure-code-profile set",
+            "name": "scrub_ec",
+            "profile": ["k=2", "m=1", "plugin=jerasure"],
+        }
+    )
+    assert rc == 0, outs
+    client.pool_create(
+        "ecscrub", pool_type=3, pg_num=2,
+        erasure_code_profile="scrub_ec", min_size=2,
+    )
+    io = client.open_ioctx("ecscrub")
+    io.write_full("shardy", b"erasure coded payload " * 100)
+    primary_osd, pg = _pg_of(cluster, client, "ecscrub", "shardy")
+    assert pg is not None
+    victim = next(o for o in pg.acting if o != primary_osd.whoami)
+    vstore = cluster.osds[victim].store
+    from ceph_tpu.store.objectstore import Transaction
+
+    raw = bytearray(vstore.read(pg.cid, OBJ_PREFIX + "shardy"))
+    raw[0] ^= 0xFF
+    vstore.queue_transaction(
+        Transaction().write(
+            pg.cid, OBJ_PREFIX + "shardy", 0, bytes(raw)
+        )
+    )
+    assert wait_for(
+        lambda: any(
+            e["oid"] == "shardy" and e.get("corrupt")
+            for e in pg.scrub_errors
+        ),
+        15.0,
+    ), f"EC scrub never flagged the shard: {pg.scrub_errors}"
+
+
+def test_recovery_respects_concurrency_cap(cluster, client):
+    io = client.open_ioctx("scrubpool")
+    victim = 2
+    store = cluster.osds[victim].store
+    for osd in cluster.osds.values():
+        osd.recovery_active_peak = 0
+    cluster.kill_osd(victim)
+    assert wait_for(
+        lambda: not client.monc.osdmap.is_up(victim), 15.0
+    )
+    for i in range(16):
+        io.write_full(f"bulk{i}", bytes([i]) * 4096)
+    cluster.start_osd(victim, store=store)
+    assert wait_for(
+        lambda: sum(
+            1
+            for i in range(16)
+            for cid in store.list_collections()
+            if cid.startswith("pg_")
+            and store.exists(cid, OBJ_PREFIX + f"bulk{i}")
+        )
+        >= 16,
+        25.0,
+    ), "revived OSD never recovered the bulk objects"
+    peaks = {
+        o: osd.recovery_active_peak
+        for o, osd in cluster.osds.items()
+    }
+    assert any(p > 0 for p in peaks.values()), peaks
+    assert all(
+        p <= osd.recovery_max_active
+        for (o, p), osd in zip(
+            peaks.items(),
+            (cluster.osds[o] for o in peaks),
+        )
+    ), peaks
