@@ -667,6 +667,79 @@ class MWatchNotifyAck(Message):
         )
 
 
+# -- lossless-peer sessions (ProtocolV2 session reconnect/replay) ----------
+
+
+@register_message
+@dataclass
+class MSessionOpen(Message):
+    """Session handshake (ProtocolV2 RECONNECT frame role): names the
+    logical session and reports the sender's last received seq so the
+    peer can prune acked messages and replay the rest."""
+
+    TYPE = 28
+    session: str = ""
+    last_in_seq: int = 0
+    # dialer incarnation id: a changed nonce tells the acceptor the
+    # client's session state reset (fresh daemon), so stale in_seq
+    # must not dedup-drop the new incarnation's messages
+    nonce: str = ""
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.session).u64(self.last_in_seq)
+        e.string(self.nonce)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MSessionOpen":
+        return cls(
+            session=d.string(), last_in_seq=d.u64(),
+            nonce=d.string(),
+        )
+
+
+@register_message
+@dataclass
+class MSessionData(Message):
+    """Seq-stamped envelope: ``inner`` is a complete message frame.
+    The receiver drops seq <= its in_seq (redelivery after replay)
+    and otherwise processes the inner frame as if it arrived bare."""
+
+    TYPE = 29
+    seq: int = 0
+    inner: bytes = b""
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.u64(self.seq).bytes(self.inner)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MSessionData":
+        return cls(seq=d.u64(), inner=d.bytes())
+
+
+@register_message
+@dataclass
+class MSessionAck(Message):
+    """Cumulative ack (bounds the sender's replay buffer); with
+    ``nack`` set it reports a sequence GAP — the receiver saw a seq
+    beyond last_in_seq+1 — and the sender must resend everything
+    after last_in_seq in order."""
+
+    TYPE = 30
+    session: str = ""
+    last_in_seq: int = 0
+    nack: bool = False
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.session).u64(self.last_in_seq)
+        e.bool(self.nack)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MSessionAck":
+        return cls(
+            session=d.string(), last_in_seq=d.u64(), nack=d.bool()
+        )
+
+
 # election ops (Elector.cc / ElectionLogic.cc roles)
 ELECT_PROPOSE = 0
 ELECT_ACK = 1

@@ -111,6 +111,14 @@ class Connection:
     async def _send(self, msg: Message) -> None:
         if self._closed:
             raise MessageError("connection closed")
+        n = self.msgr.inject_socket_failures
+        if n:
+            self.msgr._inject_count += 1
+            if self.msgr._inject_count % n == 0:
+                await self._close()
+                raise MessageError(
+                    "injected socket failure (ms_inject_socket_failures)"
+                )
         frame = msg.to_frame()
         async with self._send_lock:
             self._writer.write(frame)
@@ -187,6 +195,44 @@ class Messenger:
         self.auth_server = auth_server
         self.auth_client = auth_client
         self.bound_addr: tuple[str, int] | None = None
+        # lossless-peer sessions (msg/session.py), created lazily
+        self._session_service = None
+        self._session_conns: dict[tuple, object] = {}
+        self._session_lock = threading.Lock()
+        # fault injection (ms_inject_socket_failures,
+        # src/common/options.cc:1087): every Nth outbound frame tears
+        # the connection down instead of sending; 0 = off
+        self.inject_socket_failures = 0
+        self._inject_count = 0
+
+    # -- lossless-peer sessions (ProtocolV2 reconnect/replay role) ---------
+    def _sessions(self):
+        if self._session_service is None:
+            from .session import SessionService
+
+            svc = SessionService(self)
+            # envelopes must unwrap before application dispatchers
+            self._dispatchers.insert(0, svc)
+            self._session_service = svc
+        return self._session_service
+
+    def connect_session(self, host: str, port: int, name: str):
+        """A lossless-peer connection: survives TCP drops, replays
+        unacked messages on reconnect (src/msg/Policy.h
+        lossless_peer).  One persistent object per (peer, name)."""
+        from .session import SessionConnection
+
+        self._sessions()  # inbound replies need the unwrapper
+        key = (host, int(port), name)
+        with self._session_lock:
+            sc = self._session_conns.get(key)
+            if sc is None or sc.is_closed:
+                sc = SessionConnection(self, host, int(port), name)
+                self._session_conns[key] = sc
+            return sc
+
+    def session_client_register(self, conn, sc) -> None:
+        self._sessions().client_register(conn, sc)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -203,6 +249,7 @@ class Messenger:
     def bind(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
         """Listen; returns the bound (host, port)."""
         self.start()
+        self._sessions()  # listeners serve lossless-peer handshakes
 
         async def _serve():
             self._server = await asyncio.start_server(

@@ -217,7 +217,8 @@ class OSD(Dispatcher):
         self._worker: threading.Thread | None = None
         self._ticker: threading.Thread | None = None
         self._stop = threading.Event()
-        self._conns: dict[int, Connection] = {}
+        # osd id → (addr, lossless-peer SessionConnection)
+        self._conns: dict[int, tuple] = {}
         self._conn_lock = threading.Lock()
         self.hb = HeartbeatTracker(whoami, grace=heartbeat_grace)
         self.tick_interval = tick_interval
@@ -291,20 +292,31 @@ class OSD(Dispatcher):
         self._workq.put(("map", epoch))
 
     def _peer_conn(self, osd: int) -> Connection:
-        with self._conn_lock:
-            conn = self._conns.get(osd)
-            if conn is not None and not conn._closed:
-                return conn
+        """OSD↔OSD links are LOSSLESS PEERS (src/msg/Policy.h): the
+        session survives TCP drops and replays unacked messages on
+        reconnect, so a mid-repop connection loss commits exactly
+        once without a client-visible retry."""
         osdmap = self.monc.osdmap
         addr = osdmap.osd_addrs.get(osd, "")
+        with self._conn_lock:
+            cached = self._conns.get(osd)
+            if cached is not None:
+                c_addr, conn = cached
+                if c_addr == addr and not conn._closed:
+                    return conn
+                # peer re-registered at a new address: the old session
+                # is for a dead incarnation
+                conn.close()
         host, _, port = addr.partition(":")
         if not port:
             # peer already marked down (mark_down drops the addr): the
             # caller treats it like any unreachable peer
             raise MessageError(f"osd.{osd} has no address")
-        conn = self.messenger.connect(host, int(port))
+        conn = self.messenger.connect_session(
+            host, int(port), f"osd.{self.whoami}-{osd}"
+        )
         with self._conn_lock:
-            self._conns[osd] = conn
+            self._conns[osd] = (addr, conn)
         return conn
 
     def _load_pgs(self) -> None:
@@ -1341,7 +1353,8 @@ class OSD(Dispatcher):
                     MOSDRepOp(
                         pgid=pg.pgid, epoch=epoch, txn=txn,
                         entry_blob=entry_blob,
-                    )
+                    ),
+                    timeout=10.0,
                 )
                 if isinstance(ack, MOSDRepOpReply) and not ack.ok:
                     failed.append(osd)

@@ -1,0 +1,161 @@
+"""Lossless-peer sessions: reconnect + replay + dedup
+(src/msg/async/ProtocolV2.cc session reconnect, src/msg/Policy.h
+lossless_peer), fault injection (ms_inject_socket_failures,
+src/common/options.cc:1087), and the exactly-once write guarantee
+across a mid-repop connection drop."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.msg import Messenger, MPing, Message
+from ceph_tpu.msg.message import MOSDOpReply
+from ceph_tpu.msg.messenger import Dispatcher, wait_for
+from ceph_tpu.rados import Rados
+
+from test_osd_daemon import MiniCluster
+
+
+class EchoServer(Dispatcher):
+    """Counts every (deduped) delivery; echoes pings."""
+
+    def __init__(self):
+        self.received: list[float] = []
+
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MPing) and not msg.is_reply:
+            self.received.append(msg.stamp)
+            conn.send(
+                MPing(
+                    tid=msg.tid, from_osd=99, stamp=msg.stamp,
+                    is_reply=True,
+                )
+            )
+            return True
+        return False
+
+
+def test_session_survives_socket_kill_and_replays():
+    srv_msgr = Messenger("sess-srv")
+    srv = EchoServer()
+    srv_msgr.add_dispatcher(srv)
+    host, port = srv_msgr.bind()
+    cli_msgr = Messenger("sess-cli")
+    try:
+        sc = cli_msgr.connect_session(host, port, "t1")
+        r = sc.call(MPing(from_osd=1, stamp=1.0))
+        assert isinstance(r, MPing) and r.is_reply
+        # kill the underlying socket from the server side
+        for conn in list(srv_msgr._conns):
+            conn.close()
+        assert wait_for(lambda: sc._conn.is_closed, 5.0)
+        # the session transparently reconnects and the call completes
+        r = sc.call(MPing(from_osd=1, stamp=2.0))
+        assert isinstance(r, MPing) and r.stamp == 2.0
+        assert srv.received == [1.0, 2.0]
+    finally:
+        cli_msgr.shutdown()
+        srv_msgr.shutdown()
+
+
+def test_session_replays_unacked_after_drop_without_duplicates():
+    srv_msgr = Messenger("sess-srv2")
+    srv = EchoServer()
+    srv_msgr.add_dispatcher(srv)
+    host, port = srv_msgr.bind()
+    cli_msgr = Messenger("sess-cli2")
+    try:
+        sc = cli_msgr.connect_session(host, port, "t2")
+        # inject: every 3rd outbound frame from the CLIENT messenger
+        # tears the connection down instead of transmitting
+        cli_msgr.inject_socket_failures = 3
+        for i in range(30):
+            sc.call(MPing(from_osd=1, stamp=float(i)), timeout=10.0)
+        cli_msgr.inject_socket_failures = 0
+        # every ping delivered exactly once, in order
+        assert srv.received == [float(i) for i in range(30)]
+    finally:
+        cli_msgr.shutdown()
+        srv_msgr.shutdown()
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster()
+    for i in range(3):
+        c.start_osd(i)
+    c.wait_active()
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+def test_write_commits_exactly_once_across_repop_drops(cluster):
+    """Drop OSD↔OSD connections mid-repop (injected socket failures
+    on every OSD messenger): writes succeed and each lands exactly
+    once on every replica — session replay + seq dedup on the rep-op
+    path, reqid dedup on the client path."""
+    client = Rados("once").connect(*cluster.mon_addr)
+    # a write may ride out several injected teardowns; the objecter's
+    # internal retries reuse ONE reqid, so a long timeout preserves
+    # the exactly-once property under test
+    client.objecter.op_timeout = 60.0
+    try:
+        client.pool_create("oncepool", pg_num=2, size=3)
+        io = client.open_ioctx("oncepool")
+        io.write_full("warm", b"w")  # settle peering
+        pool_id = client.pool_lookup("oncepool")
+
+        def log_entries():
+            """per-OSD list of (pgid, version, oid) client-op entries."""
+            out = {}
+            for o, osd in cluster.osds.items():
+                entries = []
+                for pg in osd.pgs.values():
+                    if pg.pool_id != pool_id:
+                        continue
+                    entries.extend(
+                        (pg.pgid, e.version, e.oid)
+                        for e in pg.log.entries
+                    )
+                out[o] = sorted(entries)
+            return out
+
+        for osd in cluster.osds.values():
+            osd.messenger.inject_socket_failures = 10
+        try:
+            payloads = {}
+            for i in range(12):
+                data = bytes([i]) * 512
+                io.write_full(f"once{i}", data)
+                payloads[f"once{i}"] = data
+        finally:
+            for osd in cluster.osds.values():
+                osd.messenger.inject_socket_failures = 0
+        # reads agree
+        for oid, data in payloads.items():
+            assert io.read(oid) == data
+        # give straggler replication a moment, then compare logs:
+        # every OSD holds each entry AT MOST once (dedup held), and
+        # all three agree once the dust settles
+        def logs_converged():
+            logs = log_entries()
+            for entries in logs.values():
+                if len(entries) != len(set(entries)):
+                    return False  # duplicate applied entry!
+            vals = list(logs.values())
+            return vals[0] == vals[1] == vals[2]
+
+        assert wait_for(logs_converged, 20.0), log_entries()
+        # and every logical write appears exactly once per OSD
+        logs = log_entries()
+        for o, entries in logs.items():
+            oids = [e[2] for e in entries]
+            for i in range(12):
+                assert oids.count(f"once{i}") == 1, (o, oids)
+    finally:
+        client.shutdown()

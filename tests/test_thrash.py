@@ -1,0 +1,107 @@
+"""Thrasher: random OSD kills/revives under continuous client load,
+cluster converges clean (the OSDThrasher role,
+qa/tasks/ceph_manager.py:127)."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from ceph_tpu.msg.messenger import wait_for
+from ceph_tpu.osd.daemon import OBJ_PREFIX
+from ceph_tpu.rados import Rados, RadosError
+
+from test_osd_daemon import MiniCluster
+
+
+def test_thrash_kills_revives_under_load():
+    rng = random.Random(42)
+    c = MiniCluster()
+    stores = {}
+    for i in range(3):
+        stores[i] = c.start_osd(i).store
+    c.wait_active()
+    client = Rados("thrash").connect(*c.mon_addr)
+    try:
+        client.pool_create("thrashpool", pg_num=2, size=3)
+        io = client.open_ioctx("thrashpool")
+        io.write_full("seed", b"s")
+        stop = threading.Event()
+        written: dict[str, bytes] = {}
+        wlock = threading.Lock()
+        errors: list[str] = []
+
+        def load():
+            i = 0
+            while not stop.is_set():
+                oid = f"t{i % 24}"
+                data = bytes([i % 256]) * (64 + (i % 5) * 100)
+                try:
+                    io.write_full(oid, data)
+                    with wlock:
+                        written[oid] = data
+                    got = io.read(oid)
+                    if got != data:
+                        errors.append(
+                            f"{oid}: read {got[:12]!r} != written"
+                        )
+                except RadosError:
+                    pass  # a thrash window; the next loop retries
+                i += 1
+                time.sleep(0.02)
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        # thrash: three kill/revive cycles on random OSDs
+        for _ in range(3):
+            victim = rng.choice(sorted(c.osds))
+            c.kill_osd(victim)
+            deadline = time.monotonic() + 15
+            while (
+                client.monc.osdmap.is_up(victim)
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.1)
+            time.sleep(1.0)  # degraded window under load
+            c.start_osd(victim, store=stores[victim])
+            assert wait_for(
+                lambda: client.monc.osdmap.is_up(victim), 15.0
+            )
+            time.sleep(0.5)
+        stop.set()
+        t.join(timeout=10)
+        assert not errors, errors
+        assert written, "load thread never completed a write"
+
+        # convergence: every written object reads back correctly and
+        # every OSD ends with identical object bytes
+        for oid, data in sorted(written.items()):
+            assert io.read(oid) == data
+        pool_id = client.pool_lookup("thrashpool")
+
+        def replicas_agree():
+            for oid, data in written.items():
+                copies = []
+                for osd in c.osds.values():
+                    for pg in osd.pgs.values():
+                        if pg.pool_id != pool_id:
+                            continue
+                        try:
+                            copies.append(
+                                osd.store.read(
+                                    pg.cid, OBJ_PREFIX + oid
+                                )
+                            )
+                        except Exception:
+                            pass
+                if len(copies) != 3 or any(
+                    cp != data for cp in copies
+                ):
+                    return False
+            return True
+
+        assert wait_for(replicas_agree, 25.0), "replicas diverged"
+    finally:
+        client.shutdown()
+        c.shutdown()
