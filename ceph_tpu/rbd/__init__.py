@@ -1,0 +1,277 @@
+"""librbd analog — block images striped over the object layer
+(src/librbd/librbd.cc public surface; image metadata in the
+cls_rbd/omap style: header object + rbd_directory index;
+data objects laid out by the Striper, src/osdc/Striper.cc).
+
+An image is:
+
+- ``rbd_header.<name>`` — an object whose OMAP holds size, order and
+  stripe layout (the cls_rbd header pattern: metadata as omap keys,
+  not serialized blobs, so partial updates are single-key writes).
+- ``rbd_directory`` — pool-wide omap index of image names (cls_rbd's
+  directory object).
+- ``rbd_data.<name>.<object_no:016x>`` — data objects, SPARSE: a
+  never-written object simply doesn't exist and reads as zeros.
+
+I/O maps logical extents through the Striper and fans per-object ops
+out on a thread pool (the io dispatch/ObjectCacher parallelism role —
+and on an erasure pool this is the batch feeder for the TPU encode
+seam: ``stripe_count`` concurrent full-object writes per window).
+Snapshots delegate to pool snapshots (``Image.set_snap`` routes reads
+through the pool snap context) — a documented deviation from librbd's
+per-image snap contexts.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+
+from ..osdc.striper import StripeLayout, map_extent
+from ..osdc.objecter import ObjectNotFound, RadosError
+
+__all__ = ["RBD", "Image", "RBDError", "StripeLayout"]
+
+DIRECTORY = "rbd_directory"
+_IO_WORKERS = 8
+
+
+class RBDError(RadosError):
+    pass
+
+
+def _header_oid(name: str) -> str:
+    return f"rbd_header.{name}"
+
+
+def _data_oid(name: str, objectno: int) -> str:
+    return f"rbd_data.{name}.{objectno:016x}"
+
+
+class RBD:
+    """Pool-level image management (the librbd::RBD surface)."""
+
+    def create(
+        self,
+        ioctx,
+        name: str,
+        size: int,
+        stripe_unit: int = 1 << 22,
+        stripe_count: int = 1,
+        object_size: int = 1 << 22,
+    ) -> None:
+        if size < 0:
+            raise RBDError("negative image size")
+        layout = StripeLayout(stripe_unit, stripe_count, object_size)
+        existing = ioctx.omap_get_vals(DIRECTORY) if self._dir_exists(
+            ioctx
+        ) else {}
+        if name in existing:
+            raise RBDError(f"image {name!r} exists (-EEXIST)")
+        ioctx.write_full(_header_oid(name), b"")
+        ioctx.omap_set(
+            _header_oid(name),
+            {
+                "size": str(size).encode(),
+                "stripe_unit": str(layout.stripe_unit).encode(),
+                "stripe_count": str(layout.stripe_count).encode(),
+                "object_size": str(layout.object_size).encode(),
+            },
+        )
+        ioctx.omap_set(DIRECTORY, {name: b"1"})
+
+    @staticmethod
+    def _dir_exists(ioctx) -> bool:
+        try:
+            ioctx.stat(DIRECTORY)
+            return True
+        except (ObjectNotFound, RadosError):
+            return False
+
+    def list(self, ioctx) -> list[str]:
+        if not self._dir_exists(ioctx):
+            return []
+        return sorted(ioctx.omap_get_vals(DIRECTORY))
+
+    def remove(self, ioctx, name: str) -> None:
+        img = Image(ioctx, name)
+        try:
+            for objectno in range(img._max_objects()):
+                try:
+                    ioctx.remove(_data_oid(name, objectno))
+                except (ObjectNotFound, RadosError):
+                    pass
+        finally:
+            img.close()
+        ioctx.remove(_header_oid(name))
+        ioctx.omap_rm_keys(DIRECTORY, [name])
+
+
+class Image:
+    """One open image (librbd::Image): striped read/write/discard,
+    resize, snapshot-routed reads."""
+
+    def __init__(self, ioctx, name: str):
+        self.ioctx = ioctx
+        self.name = name
+        try:
+            meta = ioctx.omap_get_vals(_header_oid(name))
+        except (ObjectNotFound, RadosError) as e:
+            raise RBDError(f"image {name!r} not found: {e}")
+        if "size" not in meta:
+            raise RBDError(f"image {name!r} has no header metadata")
+        self._size = int(meta["size"])
+        self.layout = StripeLayout(
+            int(meta["stripe_unit"]),
+            int(meta["stripe_count"]),
+            int(meta["object_size"]),
+        )
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=_IO_WORKERS,
+            thread_name_prefix=f"rbd.{name}",
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+
+    def __enter__(self) -> "Image":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- metadata ----------------------------------------------------------
+    def size(self) -> int:
+        return self._size
+
+    def stat(self) -> dict:
+        return {
+            "size": self._size,
+            "obj_size": self.layout.object_size,
+            "stripe_unit": self.layout.stripe_unit,
+            "stripe_count": self.layout.stripe_count,
+            "num_objs": self._max_objects(),
+        }
+
+    def _max_objects(self) -> int:
+        if self._size == 0:
+            return 0
+        last = map_extent(self.layout, self._size - 1, 1)
+        return last[-1][0] + 1
+
+    def resize(self, new_size: int) -> None:
+        """Grow is metadata-only (sparse); shrink trims the dropped
+        range first — whole objects are removed and the boundary
+        object's tail zeroed (librbd trim)."""
+        if new_size < 0:
+            raise RBDError("negative image size")
+        old = self._size
+        if new_size < old:
+            self.discard(new_size, old - new_size)
+        self._size = new_size
+        self.ioctx.omap_set(
+            _header_oid(self.name), {"size": str(new_size).encode()}
+        )
+
+    # -- data path ---------------------------------------------------------
+    def read(self, offset: int, length: int) -> bytes:
+        """Striped read; holes (missing objects / short objects) read
+        as zeros (sparse semantics)."""
+        if offset < 0 or length < 0:
+            raise RBDError("negative read extent")
+        length = max(0, min(length, self._size - offset))
+        if length == 0:
+            return b""
+        extents = map_extent(self.layout, offset, length)
+
+        def read_one(ext):
+            objectno, obj_off, n = ext
+            try:
+                data = self.ioctx.read(
+                    _data_oid(self.name, objectno), length=n,
+                    offset=obj_off,
+                )
+            except (ObjectNotFound, RadosError):
+                data = b""
+            return data + b"\0" * (n - len(data))
+
+        parts = list(self._pool.map(read_one, extents))
+        return b"".join(parts)
+
+    def write(self, offset: int, data: bytes) -> int:
+        if offset < 0:
+            raise RBDError("negative write offset")
+        data = bytes(data)
+        if offset + len(data) > self._size:
+            raise RBDError(
+                f"write past image end ({offset + len(data)} > "
+                f"{self._size}) (-EINVAL)"
+            )
+        extents = map_extent(self.layout, offset, len(data))
+        cuts = []
+        pos = 0
+        for objectno, obj_off, n in extents:
+            cuts.append((objectno, obj_off, data[pos : pos + n]))
+            pos += n
+
+        def write_one(cut):
+            objectno, obj_off, chunk = cut
+            self.ioctx.write(
+                _data_oid(self.name, objectno), chunk, offset=obj_off
+            )
+
+        list(self._pool.map(write_one, cuts))
+        return len(data)
+
+    def discard(self, offset: int, length: int) -> None:
+        """Zero a range (librbd discard): whole objects drop, partial
+        ranges overwrite with zeros."""
+        length = max(0, min(length, self._size - offset))
+        if length == 0:
+            return
+        for objectno, obj_off, n in map_extent(
+            self.layout, offset, length
+        ):
+            oid = _data_oid(self.name, objectno)
+            if obj_off == 0 and n == self.layout.object_size:
+                try:
+                    self.ioctx.remove(oid)
+                except (ObjectNotFound, RadosError):
+                    pass
+            else:
+                try:
+                    self.ioctx.write(oid, b"\0" * n, offset=obj_off)
+                except RadosError:
+                    pass
+
+    # -- aio (librbd completions) ------------------------------------------
+    def aio_read(self, offset: int, length: int):
+        return self._pool.submit(self.read, offset, length)
+
+    def aio_write(self, offset: int, data: bytes):
+        return self._pool.submit(self.write, offset, bytes(data))
+
+    # -- snapshots (pool-snap delegation; documented deviation) ------------
+    def snap_create(self, snap_name: str) -> int:
+        return self.ioctx.snap_create(f"{self.name}@{snap_name}")
+
+    def snap_remove(self, snap_name: str) -> None:
+        self.ioctx.snap_remove(f"{self.name}@{snap_name}")
+
+    def snap_list(self) -> list[str]:
+        prefix = f"{self.name}@"
+        return sorted(
+            n[len(prefix):]
+            for n in self.ioctx.snap_list().values()
+            if n.startswith(prefix)
+        )
+
+    def set_snap(self, snap_name: str | None) -> None:
+        """Route reads through a snapshot (librbd::Image::snap_set);
+        None returns to the head."""
+        if snap_name is None:
+            self.ioctx.snap_set_read(0)
+        else:
+            self.ioctx.snap_set_read(f"{self.name}@{snap_name}")
