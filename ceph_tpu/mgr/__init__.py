@@ -1,0 +1,330 @@
+"""Manager daemon — the module host
+(src/mgr/Mgr.cc + src/pybind/mgr/mgr_module.py).
+
+The reference mgr embeds CPython to run python modules against
+cluster state it mirrors from the monitors.  Here the host IS python:
+``Manager`` keeps a live OSDMap via a MonClient subscription, hosts
+``MgrModule`` subclasses on a shared tick, and gives them the
+mgr_module surface that matters:
+
+- ``self.get("osd_map") / get("pg_summary") / get("df")`` — cluster
+  state snapshots
+- ``self.mon_command(cmd)`` — the command path back to the quorum
+- per-module config via ``set_module_option``
+
+Built-in modules (the pybind/mgr counterparts):
+
+- ``balancer`` — runs the upmap balancer library
+  (ceph_tpu/osd/balancer.py calc_pg_upmaps) on a COPY of the map and
+  commits the new pg_upmap_items through "osd pg-upmap-items", the
+  reference balancer module's active mode.
+- ``prometheus`` — an HTTP /metrics endpoint in the Prometheus text
+  exposition format (ceph_osd_up, ceph_osd_in, ceph_pool_*,
+  ceph_pg_total ...), the src/pybind/mgr/prometheus role.
+- ``status`` — health/df rollups for the CLI surface.
+"""
+
+from __future__ import annotations
+
+import copy
+import http.server
+import json
+import threading
+import time
+
+from ..mon.monitor import MonClient
+from ..msg import Messenger
+
+__all__ = ["Manager", "MgrModule"]
+
+
+class MgrModule:
+    """Base class for manager modules (mgr_module.MgrModule)."""
+
+    NAME = "module"
+    TICK_EVERY = 1.0  # seconds between serve() calls
+
+    def __init__(self, mgr: "Manager"):
+        self.mgr = mgr
+        self._last_tick = 0.0
+
+    # -- the mgr_module surface -------------------------------------------
+    def get(self, what: str):
+        return self.mgr.get(what)
+
+    def mon_command(self, cmd: dict):
+        return self.mgr.monc.command(cmd)
+
+    def get_module_option(self, key: str, default=None):
+        return self.mgr.module_options.get(self.NAME, {}).get(
+            key, default
+        )
+
+    def serve(self) -> None:  # pragma: no cover — interface hook
+        """Called on the host tick, at most every TICK_EVERY s."""
+
+    def shutdown(self) -> None:
+        pass
+
+
+class Manager:
+    """The mgr daemon: mon session + module host (Mgr.cc)."""
+
+    def __init__(self, modules: list[type[MgrModule]] | None = None):
+        self.messenger = Messenger("mgr")
+        self.monc = MonClient(self.messenger, whoami=-2)
+        self.module_options: dict[str, dict] = {}
+        self._module_types = list(
+            modules
+            if modules is not None
+            else [BalancerModule, PrometheusModule, StatusModule]
+        )
+        self.modules: dict[str, MgrModule] = {}
+        self._ticker: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def set_module_option(self, module: str, key: str, value) -> None:
+        self.module_options.setdefault(module, {})[key] = value
+
+    def start(self, mon_addrs) -> None:
+        if isinstance(mon_addrs, tuple):
+            mon_addrs = [mon_addrs]
+        self.monc.connect_any(mon_addrs)
+        for mtype in self._module_types:
+            mod = mtype(self)
+            self.modules[mod.NAME] = mod
+        self._ticker = threading.Thread(
+            target=self._tick_loop, name="mgr.tick", daemon=True
+        )
+        self._ticker.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=5)
+        for mod in self.modules.values():
+            try:
+                mod.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        self.messenger.shutdown()
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(0.2):
+            now = time.monotonic()
+            for mod in self.modules.values():
+                if now - mod._last_tick < mod.TICK_EVERY:
+                    continue
+                mod._last_tick = now
+                try:
+                    mod.serve()
+                except Exception:  # noqa: BLE001 — a module must not
+                    # kill the host (mgr module crash containment)
+                    import traceback
+
+                    traceback.print_exc()
+
+    # -- cluster state snapshots (MgrModule.get) ---------------------------
+    def get(self, what: str):
+        m = self.monc.osdmap
+        if m is None:
+            return None
+        if what == "osd_map":
+            return m
+        if what == "osd_stats":
+            return {
+                "epoch": m.epoch,
+                "num_osds": m.max_osd,
+                "num_up": sum(
+                    1 for o in range(m.max_osd) if m.is_up(o)
+                ),
+                "num_in": sum(
+                    1
+                    for o in range(m.max_osd)
+                    if m.exists(o) and m.osd_weight[o] > 0
+                ),
+            }
+        if what == "pg_summary":
+            total = sum(p.pg_num for p in m.pools.values())
+            return {
+                "num_pools": len(m.pools),
+                "num_pgs": total,
+                "by_pool": {
+                    pid: p.pg_num for pid, p in m.pools.items()
+                },
+            }
+        if what == "df":
+            return {
+                "pools": [
+                    {
+                        "name": m.pool_names.get(pid, str(pid)),
+                        "id": pid,
+                        "type": p.type,
+                        "size": p.size,
+                        "pg_num": p.pg_num,
+                    }
+                    for pid, p in m.pools.items()
+                ],
+            }
+        raise KeyError(f"unknown mgr state {what!r}")
+
+
+class StatusModule(MgrModule):
+    """Health rollup (the mgr status/health surface)."""
+
+    NAME = "status"
+
+    def health(self) -> dict:
+        stats = self.get("osd_stats")
+        if stats is None:
+            return {"status": "HEALTH_WARN", "checks": ["no map"]}
+        checks = []
+        if stats["num_up"] < stats["num_in"]:
+            checks.append(
+                f"{stats['num_in'] - stats['num_up']} osds down"
+            )
+        return {
+            "status": "HEALTH_OK" if not checks else "HEALTH_WARN",
+            "checks": checks,
+            **stats,
+        }
+
+
+class BalancerModule(MgrModule):
+    """Active upmap balancing (src/pybind/mgr/balancer, mode=upmap):
+    plan on a map copy, commit the delta via pg-upmap-items."""
+
+    NAME = "balancer"
+    TICK_EVERY = 1.0
+
+    def __init__(self, mgr: "Manager"):
+        super().__init__(mgr)
+        self.last_plan: dict = {}
+        self.plans_applied = 0
+
+    def serve(self) -> None:
+        if not self.get_module_option("active", False):
+            return
+        m = self.get("osd_map")
+        if m is None:
+            return
+        from ..osd.balancer import calc_pg_upmaps
+
+        plan_map = copy.deepcopy(m)
+        changed = calc_pg_upmaps(
+            plan_map,
+            max_deviation=int(
+                self.get_module_option("upmap_max_deviation", 1)
+            ),
+            max_changes=int(
+                self.get_module_option("max_optimizations", 10)
+            ),
+        )
+        if not changed:
+            return
+        delta = {
+            pg: items
+            for pg, items in plan_map.pg_upmap_items.items()
+            if m.pg_upmap_items.get(pg) != items
+        }
+        self.last_plan = {
+            f"{pid}.{ps}": items for (pid, ps), items in delta.items()
+        }
+        for (pid, ps), items in delta.items():
+            reply = self.mon_command(
+                {
+                    "prefix": "osd pg-upmap-items",
+                    "pgid": f"{pid}.{ps}",
+                    "mappings": [list(i) for i in items],
+                }
+            )
+            if reply.rc == 0:
+                self.plans_applied += 1
+
+
+class PrometheusModule(MgrModule):
+    """/metrics exporter in the Prometheus text format
+    (src/pybind/mgr/prometheus)."""
+
+    NAME = "prometheus"
+
+    def __init__(self, mgr: "Manager"):
+        super().__init__(mgr)
+        self.port = int(self.get_module_option("port", 0))
+        module = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path not in ("/metrics", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = module.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self.server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", self.port), Handler
+        )
+        self.port = self.server.server_address[1]
+        threading.Thread(
+            target=self.server.serve_forever,
+            name="mgr.prometheus",
+            daemon=True,
+        ).start()
+
+    def shutdown(self) -> None:
+        self.server.shutdown()
+
+    def render(self) -> str:
+        out = []
+
+        def metric(name, value, help_=None, labels=None):
+            if help_:
+                out.append(f"# HELP {name} {help_}")
+                out.append(f"# TYPE {name} gauge")
+            lbl = ""
+            if labels:
+                inner = ",".join(
+                    f'{k}="{v}"' for k, v in labels.items()
+                )
+                lbl = "{" + inner + "}"
+            out.append(f"{name}{lbl} {value}")
+
+        stats = self.get("osd_stats")
+        if stats is None:
+            return "# mgr has no map yet\n"
+        metric(
+            "ceph_osdmap_epoch", stats["epoch"], "OSDMap epoch"
+        )
+        metric("ceph_num_osds", stats["num_osds"], "total osds")
+        metric("ceph_num_up_osds", stats["num_up"], "up osds")
+        metric("ceph_num_in_osds", stats["num_in"], "in osds")
+        m = self.get("osd_map")
+        for o in range(m.max_osd):
+            metric(
+                "ceph_osd_up",
+                1 if m.is_up(o) else 0,
+                "per-osd up state" if o == 0 else None,
+                labels={"ceph_daemon": f"osd.{o}"},
+            )
+        pg = self.get("pg_summary")
+        metric("ceph_pg_total", pg["num_pgs"], "total pgs")
+        for entry in self.get("df")["pools"]:
+            metric(
+                "ceph_pool_pg_num",
+                entry["pg_num"],
+                "per-pool pg count"
+                if entry is self.get("df")["pools"][0]
+                else None,
+                labels={"pool": entry["name"]},
+            )
+        return "\n".join(out) + "\n"

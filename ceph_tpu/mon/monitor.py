@@ -443,6 +443,28 @@ def _cmd_ec_profile_set(mon: Monitor, cmd: dict) -> MMonCommandReply:
     return MMonCommandReply(outb=json.dumps({"epoch": epoch}))
 
 
+def _cmd_pg_upmap_items(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """"osd pg-upmap-items <pgid> <from> <to> [...]" — the balancer's
+    commit surface (OSDMonitor's pg-upmap-items command)."""
+    pgid = cmd["pgid"]
+    try:
+        pool_id, ps = (int(x) for x in pgid.split("."))
+    except ValueError:
+        return MMonCommandReply(rc=-22, outs=f"bad pgid {pgid!r}")
+    if pool_id not in mon.osdmap.pools:
+        return MMonCommandReply(rc=-2, outs=f"no pool {pool_id}")
+    mappings = [
+        (int(a), int(b)) for a, b in cmd.get("mappings", [])
+    ]
+    inc = mon.pending()
+    if mappings:
+        inc.new_pg_upmap_items[(pool_id, ps)] = mappings
+    else:
+        inc.old_pg_upmap_items.add((pool_id, ps))
+    epoch = mon.commit(inc)
+    return MMonCommandReply(outb=json.dumps({"epoch": epoch}))
+
+
 def _cmd_osd_dump(mon: Monitor, cmd: dict) -> MMonCommandReply:
     m = mon.osdmap
     return MMonCommandReply(
@@ -616,6 +638,7 @@ _COMMANDS = {
     "osd pool delete": _cmd_pool_delete,
     "osd pool mksnap": _cmd_pool_mksnap,
     "osd pool rmsnap": _cmd_pool_rmsnap,
+    "osd pg-upmap-items": _cmd_pg_upmap_items,
     "osd erasure-code-profile set": _cmd_ec_profile_set,
     "osd erasure-code-profile get": _cmd_ec_profile_get,
     "osd erasure-code-profile ls": _cmd_ec_profile_ls,

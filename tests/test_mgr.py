@@ -1,0 +1,112 @@
+"""Manager module host (src/mgr/Mgr.cc + pybind/mgr): stats
+snapshots, the prometheus exporter, a custom module, and the active
+upmap balancer committing through the monitor."""
+
+from __future__ import annotations
+
+import time
+import urllib.request
+
+import pytest
+
+from ceph_tpu.mgr import Manager, MgrModule
+from ceph_tpu.msg.messenger import wait_for
+from ceph_tpu.rados import Rados
+
+from test_osd_daemon import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster()
+    for i in range(3):
+        c.start_osd(i)
+    c.wait_active()
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+def test_mgr_stats_prometheus_and_custom_module(cluster):
+    events = []
+
+    class PingModule(MgrModule):
+        NAME = "pinger"
+        TICK_EVERY = 0.2
+
+        def serve(self):
+            events.append(self.get("osd_stats")["num_up"])
+
+    from ceph_tpu.mgr import PrometheusModule, StatusModule
+
+    mgr = Manager(
+        modules=[PrometheusModule, StatusModule, PingModule]
+    )
+    mgr.start(cluster.mon_addr)
+    try:
+        assert wait_for(lambda: len(events) >= 2, 10.0)
+        stats = mgr.get("osd_stats")
+        assert stats["num_osds"] == 3 and stats["num_up"] == 3
+        assert mgr.get("pg_summary")["num_pgs"] >= 2
+        health = mgr.modules["status"].health()
+        assert health["status"] == "HEALTH_OK"
+        # prometheus endpoint serves real gauges
+        port = mgr.modules["prometheus"].port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "ceph_num_up_osds 3" in body
+        assert 'ceph_osd_up{ceph_daemon="osd.0"} 1' in body
+        assert "ceph_pg_total" in body
+        # a dead OSD shows up within a few ticks
+        cluster.kill_osd(2)
+        assert wait_for(
+            lambda: "ceph_num_up_osds 2"
+            in urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode(),
+            20.0,
+        )
+    finally:
+        mgr.shutdown()
+        # restore for later tests
+        cluster.start_osd(2)
+
+
+def test_balancer_module_commits_upmaps(cluster):
+    """On a skewed cluster the active balancer plans upmaps and
+    commits them via 'osd pg-upmap-items'."""
+    client = Rados("mgr-bal").connect(*cluster.mon_addr)
+    try:
+        client.pool_create("balpool", pg_num=32, size=2)
+        # skew: downweight osd.0 so PG counts leave the weight targets
+        rc, _outb, outs = client.mon_command(
+            {"prefix": "osd reweight", "id": 0, "weight": 0.5}
+        )
+        assert rc == 0, outs
+        mgr = Manager()
+        mgr.set_module_option("balancer", "active", True)
+        mgr.set_module_option("balancer", "max_optimizations", 4)
+        mgr.start(cluster.mon_addr)
+        try:
+            bal = mgr.modules["balancer"]
+            if not wait_for(lambda: bal.plans_applied > 0, 20.0):
+                pytest.skip(
+                    "cluster already balanced at this skew — no plan"
+                )
+            # the committed upmaps are in the authoritative map
+            assert wait_for(
+                lambda: len(
+                    client.monc.osdmap.pg_upmap_items
+                ) > 0,
+                10.0,
+            )
+            # and every upmap names a real pg of a real pool
+            for (pid, ps) in client.monc.osdmap.pg_upmap_items:
+                assert pid in client.monc.osdmap.pools
+                assert ps < client.monc.osdmap.pools[pid].pg_num
+        finally:
+            mgr.shutdown()
+    finally:
+        client.shutdown()
