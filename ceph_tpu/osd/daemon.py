@@ -354,7 +354,9 @@ class OSD(Dispatcher):
                 self.hb.remove_peer(osd)
                 self._reported.discard(osd)
             self._last_up[osd] = up
-        for pool_id, pool in osdmap.pools.items():
+        # snapshot: the MonClient applies incrementals on the loop
+        # thread while this walk runs on the worker
+        for pool_id, pool in list(osdmap.pools.items()):
             for ps in range(pool.pg_num):
                 up, _upp, acting, primary = osdmap.pg_to_up_acting_osds(
                     pool_id, ps

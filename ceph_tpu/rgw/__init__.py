@@ -1,0 +1,299 @@
+"""RGW analog — an HTTP object gateway over the rados layer
+(src/rgw/: the beast-frontend + rgw_rados layout, reduced to the
+load-bearing architecture).
+
+What carries over from the reference's design:
+
+- **The gateway is a rados CLIENT daemon**: it owns no storage; every
+  bucket/object operation becomes librados I/O (rgw_rados.cc's role).
+- **Bucket indexes are omap objects** (the cls_rgw bucket-index
+  pattern): ``bucket.index.<name>`` maps key → JSON entry
+  (size/etag/mtime), so listings are key-ordered omap pages with
+  marker/max-keys — exactly how S3 ListObjects pagination rides
+  RocksDB in the reference.
+- **A bucket directory object** (``rgw.buckets``) indexes the
+  buckets themselves.
+- Object payloads live at ``rgw.obj.<bucket>/<key>``; multipart-scale
+  striping would ride osdc/striper.py like rbd (not wired yet).
+
+Served surface (S3-flavored REST over http.server, the beast role):
+
+    PUT    /<bucket>                 create bucket
+    DELETE /<bucket>                 remove empty bucket
+    GET    /                         ListAllMyBuckets (XML)
+    PUT    /<bucket>/<key>           upload (body = object)
+    GET    /<bucket>/<key>           download
+    HEAD   /<bucket>/<key>           stat
+    DELETE /<bucket>/<key>           remove
+    GET    /<bucket>?marker=&max-keys=   ListObjects (XML, paged)
+
+Deviations, documented: no auth (S3 signatures/keystone/STS), no
+multipart/lifecycle/multisite, single pool.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.server
+import json
+import threading
+import time
+import urllib.parse
+from xml.sax.saxutils import escape
+
+from ..osdc.objecter import ObjectNotFound, RadosError
+
+__all__ = ["RGW", "RGWError"]
+
+BUCKETS_DIR = "rgw.buckets"
+
+
+class RGWError(Exception):
+    pass
+
+
+def _index_oid(bucket: str) -> str:
+    return f"bucket.index.{bucket}"
+
+
+def _data_oid(bucket: str, key: str) -> str:
+    return f"rgw.obj.{bucket}/{key}"
+
+
+class RGW:
+    """The gateway daemon: storage logic + embedded HTTP frontend."""
+
+    def __init__(self, ioctx):
+        self.io = ioctx
+        self.server = None
+        self.port = 0
+
+    # -- storage logic (rgw_rados roles) -----------------------------------
+    def _buckets(self) -> dict[str, bytes]:
+        try:
+            return self.io.omap_get_vals(BUCKETS_DIR)
+        except (ObjectNotFound, RadosError):
+            return {}
+
+    def create_bucket(self, bucket: str) -> None:
+        if "/" in bucket or not bucket:
+            raise RGWError(f"invalid bucket name {bucket!r}")
+        if bucket in self._buckets():
+            raise RGWError(f"bucket {bucket!r} exists")
+        self.io.write_full(_index_oid(bucket), b"")
+        self.io.omap_set(
+            BUCKETS_DIR, {bucket: str(time.time()).encode()}
+        )
+
+    def delete_bucket(self, bucket: str) -> None:
+        if bucket not in self._buckets():
+            raise RGWError(f"no bucket {bucket!r}")
+        if self.io.omap_get_vals(_index_oid(bucket), max_return=1):
+            raise RGWError(f"bucket {bucket!r} not empty")
+        self.io.remove(_index_oid(bucket))
+        self.io.omap_rm_keys(BUCKETS_DIR, [bucket])
+
+    def put_object(self, bucket: str, key: str, data: bytes) -> str:
+        if bucket not in self._buckets():
+            raise RGWError(f"no bucket {bucket!r}")
+        etag = hashlib.md5(data).hexdigest()
+        self.io.write_full(_data_oid(bucket, key), data)
+        # the index entry commits AFTER the data (the reference's
+        # prepare/complete index transaction, collapsed)
+        self.io.omap_set(
+            _index_oid(bucket),
+            {
+                key: json.dumps(
+                    {
+                        "size": len(data),
+                        "etag": etag,
+                        "mtime": time.time(),
+                    }
+                ).encode()
+            },
+        )
+        return etag
+
+    def get_object(self, bucket: str, key: str) -> bytes:
+        entry = self.stat_object(bucket, key)  # -ENOENT via index
+        data = self.io.read(_data_oid(bucket, key))
+        if len(data) != entry["size"]:
+            raise RGWError(f"{bucket}/{key}: torn object")
+        return data
+
+    def stat_object(self, bucket: str, key: str) -> dict:
+        vals = self.io.omap_get_vals(_index_oid(bucket))
+        if key not in vals:
+            raise ObjectNotFound(f"{bucket}/{key}")
+        return json.loads(vals[key])
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self.stat_object(bucket, key)
+        self.io.remove(_data_oid(bucket, key))
+        self.io.omap_rm_keys(_index_oid(bucket), [key])
+
+    def list_objects(
+        self, bucket: str, marker: str = "", max_keys: int = 1000
+    ) -> tuple[list[dict], bool]:
+        """Key-ordered page after ``marker`` → (entries, truncated):
+        one omap page read, the bucket-index listing."""
+        if bucket not in self._buckets():
+            raise RGWError(f"no bucket {bucket!r}")
+        vals = self.io.omap_get_vals(
+            _index_oid(bucket), start_after=marker,
+            max_return=max_keys + 1,
+        )
+        keys = sorted(vals)
+        truncated = len(keys) > max_keys
+        out = []
+        for k in keys[:max_keys]:
+            entry = json.loads(vals[k])
+            entry["key"] = k
+            out.append(entry)
+        return out, truncated
+
+    # -- HTTP frontend (the beast role) ------------------------------------
+    def serve(self, port: int = 0) -> int:
+        gw = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, body=b"", ctype="application/xml",
+                       headers=None):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _err(self, code, name, msg):
+                body = (
+                    f"<Error><Code>{name}</Code>"
+                    f"<Message>{escape(msg)}</Message></Error>"
+                ).encode()
+                self._reply(code, body)
+
+            def _route(self):
+                parsed = urllib.parse.urlparse(self.path)
+                parts = parsed.path.strip("/").split("/", 1)
+                bucket = parts[0] if parts[0] else None
+                key = parts[1] if len(parts) > 1 else None
+                q = dict(urllib.parse.parse_qsl(parsed.query))
+                return bucket, key, q
+
+            def do_GET(self):  # noqa: N802
+                bucket, key, q = self._route()
+                try:
+                    if bucket is None:
+                        names = sorted(gw._buckets())
+                        inner = "".join(
+                            f"<Bucket><Name>{escape(n)}</Name></Bucket>"
+                            for n in names
+                        )
+                        self._reply(
+                            200,
+                            (
+                                "<ListAllMyBucketsResult><Buckets>"
+                                f"{inner}</Buckets>"
+                                "</ListAllMyBucketsResult>"
+                            ).encode(),
+                        )
+                    elif key is None:
+                        entries, trunc = gw.list_objects(
+                            bucket,
+                            marker=q.get("marker", ""),
+                            max_keys=int(q.get("max-keys", 1000)),
+                        )
+                        inner = "".join(
+                            "<Contents>"
+                            f"<Key>{escape(e['key'])}</Key>"
+                            f"<Size>{e['size']}</Size>"
+                            f"<ETag>\"{e['etag']}\"</ETag>"
+                            "</Contents>"
+                            for e in entries
+                        )
+                        self._reply(
+                            200,
+                            (
+                                "<ListBucketResult>"
+                                f"<Name>{escape(bucket)}</Name>"
+                                f"<IsTruncated>{str(trunc).lower()}"
+                                f"</IsTruncated>{inner}"
+                                "</ListBucketResult>"
+                            ).encode(),
+                        )
+                    else:
+                        data = gw.get_object(bucket, key)
+                        self._reply(
+                            200, data,
+                            ctype="application/octet-stream",
+                        )
+                except ObjectNotFound as e:
+                    self._err(404, "NoSuchKey", str(e))
+                except RGWError as e:
+                    self._err(404, "NoSuchBucket", str(e))
+
+            def do_HEAD(self):  # noqa: N802
+                bucket, key, _q = self._route()
+                try:
+                    entry = gw.stat_object(bucket, key)
+                    self._reply(
+                        200, b"",
+                        headers={
+                            "ETag": f'"{entry["etag"]}"',
+                            "X-Object-Size": str(entry["size"]),
+                        },
+                    )
+                except (ObjectNotFound, RGWError):
+                    self._reply(404)
+
+            def do_PUT(self):  # noqa: N802
+                bucket, key, _q = self._route()
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                try:
+                    if key is None:
+                        gw.create_bucket(bucket)
+                        self._reply(200)
+                    else:
+                        etag = gw.put_object(bucket, key, body)
+                        self._reply(
+                            200, b"", headers={"ETag": f'"{etag}"'}
+                        )
+                except RGWError as e:
+                    self._err(409, "BucketError", str(e))
+
+            def do_DELETE(self):  # noqa: N802
+                bucket, key, _q = self._route()
+                try:
+                    if key is None:
+                        gw.delete_bucket(bucket)
+                    else:
+                        gw.delete_object(bucket, key)
+                    self._reply(204)
+                except ObjectNotFound as e:
+                    self._err(404, "NoSuchKey", str(e))
+                except RGWError as e:
+                    self._err(409, "BucketError", str(e))
+
+        self.server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), Handler
+        )
+        self.port = self.server.server_address[1]
+        threading.Thread(
+            target=self.server.serve_forever,
+            name="rgw.frontend",
+            daemon=True,
+        ).start()
+        return self.port
+
+    def shutdown(self) -> None:
+        if self.server is not None:
+            self.server.shutdown()
