@@ -164,6 +164,20 @@ def calc_pg_upmaps(
             reverse=True,
         )
         if not overfull:
+            # plateau break (the role of the reference's randomized
+            # retries): if someone is still BELOW -max_deviation, any
+            # above-target OSD may donate — integer counts cannot hit
+            # fractional targets, so the worst under-filled OSD would
+            # otherwise stay stranded behind donors at dev <= max_dev
+            if any(
+                deviation(o) < -max_deviation for o in osd_weight
+            ):
+                overfull = sorted(
+                    (o for o in pgs_by_osd if deviation(o) > 0.5),
+                    key=deviation,
+                    reverse=True,
+                )
+        if not overfull:
             break
         moved = False
         for src in overfull:
