@@ -158,6 +158,7 @@ def calc_pg_upmaps(
     for _ in range(max_changes * 4):  # bounded retry budget
         if num_changed >= max_changes:
             break
+        plateau = False
         overfull = sorted(
             (o for o in pgs_by_osd if deviation(o) > max_deviation),
             key=deviation,
@@ -165,15 +166,26 @@ def calc_pg_upmaps(
         )
         if not overfull:
             # plateau break (the role of the reference's randomized
-            # retries): if someone is still BELOW -max_deviation, any
-            # above-target OSD may donate — integer counts cannot hit
-            # fractional targets, so the worst under-filled OSD would
-            # otherwise stay stranded behind donors at dev <= max_dev
-            if any(
-                deviation(o) < -max_deviation for o in osd_weight
-            ):
+            # retries): integer counts cannot hit fractional targets,
+            # so an OSD can strand below -max_deviation while every
+            # donor sits at dev <= max_deviation.  In plateau mode
+            # ONLY stranded, reachable OSDs receive (otherwise moves
+            # churn between healthy OSDs forever) and any
+            # above-target OSD donates — a donor at dev > 0 lands at
+            # dev - 1 > -max_deviation, so it can never itself become
+            # stranded (no ping-pong, guaranteed progress).
+            stranded = [
+                o
+                for o in osd_weight
+                if deviation(o) < -max_deviation
+                and osdmap.is_up(o)
+                and 0 <= o < osdmap.max_osd
+                and osdmap.osd_weight[o] > 0
+            ]
+            if stranded:
+                plateau = True
                 overfull = sorted(
-                    (o for o in pgs_by_osd if deviation(o) > 0.5),
+                    (o for o in pgs_by_osd if deviation(o) > 0.0001),
                     key=deviation,
                     reverse=True,
                 )
@@ -182,7 +194,12 @@ def calc_pg_upmaps(
         moved = False
         for src in overfull:
             underfull = sorted(
-                (o for o in osd_weight if deviation(o) < -0.0001),
+                (
+                    o
+                    for o in osd_weight
+                    if deviation(o)
+                    < (-max_deviation if plateau else -0.0001)
+                ),
                 key=deviation,
             )
             if not underfull:
