@@ -39,6 +39,8 @@ from jax import lax  # noqa: E402
 from .hashing import _mix_inner  # noqa: E402
 from .ln import _tables as _ln_tables  # noqa: E402
 from .types import (  # noqa: E402
+    CRUSH_BUCKET_LIST,
+    CRUSH_BUCKET_STRAW,
     CRUSH_BUCKET_STRAW2,
     CRUSH_BUCKET_UNIFORM,
     CRUSH_ITEM_NONE,
@@ -85,6 +87,19 @@ def _hash2(a, b):
     a, b, h = _mix_inner(a, b, h)
     x, a, h = _mix_inner(x0, a, h)
     b, y, h = _mix_inner(b, y0, h)
+    return h.astype(jnp.uint32)
+
+
+def _hash4(a, b, c, d):
+    """rjenkins1 arity 4 (hash.c:61-74) — the list chooser's hash."""
+    h = jnp.uint32(1315423911) ^ a ^ b ^ c ^ d
+    x0, y0 = jnp.uint32(231232), jnp.uint32(1232)
+    a, b, h = _mix_inner(a, b, h)
+    c, d, h = _mix_inner(c, d, h)
+    a, x, h = _mix_inner(a, x0, h)
+    y, b, h = _mix_inner(y0, b, h)
+    c, x, h = _mix_inner(c, x, h)
+    y, d, h = _mix_inner(y, d, h)
     return h.astype(jnp.uint32)
 
 
@@ -142,7 +157,11 @@ class CompiledMap:
     integer range (2^53) covers the 2^48 fixed-point ln values.
     """
 
-    row_pack: jnp.ndarray  # (nb, 3*sz+3) f32: items|w_hi|w_lo|size|alg|id
+    # (nb, 7*sz+3) f32:
+    # items|w_hi|w_lo|straw_hi|straw_lo|sum_hi|sum_lo|size|alg|id
+    # (straw columns: legacy straw lengths; sum columns: the list
+    # chooser's tail sums — zero outside their algs)
+    row_pack: jnp.ndarray
     # choose_args rendering (crush.h:248-293): per-position straw2
     # weight replacements + hash-id remaps, position-clamped at compile
     # time.  None when the map carries no choose_args (zero overhead).
@@ -155,6 +174,8 @@ class CompiledMap:
     sz: int
     nb: int
     has_uniform: bool
+    has_straw: bool
+    has_list: bool
     uniform_sz: int  # max uniform-bucket size (perm loop bound)
     bidx: tuple  # host-side (-1-id) -> row for TAKE resolution
     max_devices: int
@@ -179,15 +200,22 @@ def compile_map(cmap) -> CompiledMap:
     if not cmap.buckets:
         raise UnsupportedMap("empty map")
     for b in cmap.buckets.values():
-        if b.alg not in (CRUSH_BUCKET_STRAW2, CRUSH_BUCKET_UNIFORM):
+        if b.alg not in (
+            CRUSH_BUCKET_STRAW2,
+            CRUSH_BUCKET_UNIFORM,
+            CRUSH_BUCKET_STRAW,
+            CRUSH_BUCKET_LIST,
+        ):
             raise UnsupportedMap(
                 f"bucket {b.id} alg {b.alg}: device kernel supports "
-                "straw2 and uniform buckets"
+                "straw2/uniform/straw/list buckets (tree → oracle)"
             )
     nb = len(cmap.buckets)
     sz = max(max(b.size for b in cmap.buckets.values()), 1)
     items = np.zeros((nb, sz), dtype=np.int64)
     weights = np.zeros((nb, sz), dtype=np.int64)
+    straws = np.zeros((nb, sz), dtype=np.int64)
+    sums = np.zeros((nb, sz), dtype=np.int64)
     sizes = np.zeros(nb, dtype=np.int64)
     types = np.zeros(nb, dtype=np.int64)
     algs = np.zeros(nb, dtype=np.int64)
@@ -210,6 +238,22 @@ def compile_map(cmap) -> CompiledMap:
             raise UnsupportedMap("bucket id magnitude >= 2^24")
         if b.weight >= 1 << 32:
             raise UnsupportedMap("bucket weight >= 2^32")
+        if b.alg == CRUSH_BUCKET_STRAW:
+            if not b.straws or len(b.straws) < b.size:
+                raise UnsupportedMap(
+                    f"straw bucket {b.id} missing straw table"
+                )
+            if any(s >= 1 << 32 for s in b.straws[: b.size]):
+                raise UnsupportedMap("straw length >= 2^32")
+            straws[row, : b.size] = b.straws[: b.size]
+        if b.alg == CRUSH_BUCKET_LIST:
+            if not b.sum_weights or len(b.sum_weights) < b.size:
+                raise UnsupportedMap(
+                    f"list bucket {b.id} missing sum_weights"
+                )
+            if any(s >= 1 << 32 for s in b.sum_weights[: b.size]):
+                raise UnsupportedMap("list sum weight >= 2^32")
+            sums[row, : b.size] = b.sum_weights[: b.size]
 
     # choose_args → dense per-position weight/id tables.  The C only
     # consults args in the straw2 chooser (crush_bucket_choose,
@@ -276,6 +320,10 @@ def compile_map(cmap) -> CompiledMap:
             items.astype(np.float32),
             (weights >> 16).astype(np.float32),
             (weights & 0xFFFF).astype(np.float32),
+            (straws >> 16).astype(np.float32),
+            (straws & 0xFFFF).astype(np.float32),
+            (sums >> 16).astype(np.float32),
+            (sums & 0xFFFF).astype(np.float32),
             sizes[:, None].astype(np.float32),
             algs[:, None].astype(np.float32),
             ids[:, None].astype(np.float32),
@@ -300,6 +348,8 @@ def compile_map(cmap) -> CompiledMap:
         sz=sz,
         nb=nb,
         has_uniform=bool((algs == CRUSH_BUCKET_UNIFORM).any()),
+        has_straw=bool((algs == CRUSH_BUCKET_STRAW).any()),
+        has_list=bool((algs == CRUSH_BUCKET_LIST).any()),
         uniform_sz=int(
             sizes[algs == CRUSH_BUCKET_UNIFORM].max()
         )
@@ -409,16 +459,25 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
         return jnp.matmul(oh, table, precision=HIP)
 
     def load_bucket(bidx_row):
-        """One row_pack lookup -> (ids, wf, size, alg, bid)."""
+        """One row_pack lookup ->
+        (ids, wf, strawf, sumf, size, alg, bid)."""
         row = _lookup(bidx_row, NB, cm.row_pack)
         ids = jnp.round(row[:SZ]).astype(jnp.int32)
-        wf = row[SZ : 2 * SZ].astype(jnp.float64) * 65536.0 + row[
-            2 * SZ : 3 * SZ
-        ].astype(jnp.float64)
-        size = jnp.round(row[3 * SZ]).astype(jnp.int32)
-        alg = jnp.round(row[3 * SZ + 1]).astype(jnp.int32)
-        bid = jnp.round(row[3 * SZ + 2]).astype(jnp.int32)
-        return ids, wf, size, alg, bid
+
+        def f64pair(base):
+            return row[base : base + SZ].astype(
+                jnp.float64
+            ) * 65536.0 + row[base + SZ : base + 2 * SZ].astype(
+                jnp.float64
+            )
+
+        wf = f64pair(SZ)
+        strawf = f64pair(3 * SZ)
+        sumf = f64pair(5 * SZ)
+        size = jnp.round(row[7 * SZ]).astype(jnp.int32)
+        alg = jnp.round(row[7 * SZ + 1]).astype(jnp.int32)
+        bid = jnp.round(row[7 * SZ + 2]).astype(jnp.int32)
+        return ids, wf, strawf, sumf, size, alg, bid
 
     def straw2_draw(hash_ids, ids, wf, size, x, r):
         """One straw2 draw-argmax (mapper.c:361-384).
@@ -513,10 +572,56 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
         aids = jnp.round(arow[2 * P * SZ :]).astype(jnp.int32)
         return aids, awf
 
-    def dispatch_draw(bidx_row, ids, wf, size, alg, bid, x, r, pos):
+    def straw_draw(ids, strawf, size, x, r):
+        """Legacy straw chooser (bucket_straw_choose, mapper.c:227-
+        245): draw_i = (hash3(x, item, r) & 0xffff) * straw_i, argmax
+        with first-max-wins ties.  u16 * u32 < 2^48 is f64-exact."""
+        u = (
+            _hash3(
+                jnp.uint32(x),
+                ids.astype(jnp.uint32),
+                jnp.uint32(r),
+            )
+            & jnp.uint32(0xFFFF)
+        ).astype(jnp.float64)
+        draw = jnp.where(
+            jnp.arange(SZ) < size, u * strawf, -jnp.inf
+        )
+        am = jnp.argmax(draw)  # first max, like the C's strict >
+        return jnp.sum(
+            jnp.where(jnp.arange(SZ) == am, ids, 0)
+        ).astype(jnp.int32)
+
+    def list_draw(ids, wf, sumf, size, bid, x, r):
+        """List chooser (bucket_list_choose, mapper.c:141-164): walk
+        tail→head, item i wins when
+        (hash4(x, item, r, bucket_id) & 0xffff) * sum_i >> 16 <
+        weight_i — i.e. the HIGHEST accepting index wins; items[0]
+        when nobody accepts.  u16 * u32 < 2^48 and the >>16 floor are
+        f64-exact."""
+        w = (
+            _hash4(
+                jnp.uint32(x),
+                ids.astype(jnp.uint32),
+                jnp.uint32(r),
+                bid.astype(jnp.uint32),
+            )
+            & jnp.uint32(0xFFFF)
+        ).astype(jnp.float64)
+        scaled = jnp.floor(w * sumf / 65536.0)
+        accept = (scaled < wf) & (jnp.arange(SZ) < size)
+        idx = jnp.max(jnp.where(accept, jnp.arange(SZ), -1))
+        win = jnp.maximum(idx, 0)  # items[0] when none accept
+        return jnp.sum(
+            jnp.where(jnp.arange(SZ) == win, ids, 0)
+        ).astype(jnp.int32)
+
+    def dispatch_draw(
+        bidx_row, ids, wf, strawf, sumf, size, alg, bid, x, r, pos
+    ):
         """crush_bucket_choose over already-loaded bucket data; the
-        perm path only compiles into maps that contain uniform
-        buckets, the choose_args path only into maps that carry
+        perm/straw/list paths only compile into maps containing those
+        bucket algs, the choose_args path only into maps that carry
         choose_args."""
         if cm.args_pack is not None:
             hash_ids, awf = load_args(bidx_row, pos)
@@ -526,13 +631,22 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
         if cm.has_uniform:
             uni = perm_draw(ids, size, bid, x, r)
             item = jnp.where(alg == CRUSH_BUCKET_UNIFORM, uni, item)
+        if cm.has_straw:
+            st = straw_draw(ids, strawf, size, x, r)
+            item = jnp.where(alg == CRUSH_BUCKET_STRAW, st, item)
+        if cm.has_list:
+            li = list_draw(ids, wf, sumf, size, bid, x, r)
+            item = jnp.where(alg == CRUSH_BUCKET_LIST, li, item)
         return item
 
     def bucket_draw(bidx_row, x, r, pos):
         """Load + draw; returns (item, bucket_size)."""
-        ids, wf, size, alg, bid = load_bucket(bidx_row)
+        ids, wf, strawf, sumf, size, alg, bid = load_bucket(bidx_row)
         return (
-            dispatch_draw(bidx_row, ids, wf, size, alg, bid, x, r, pos),
+            dispatch_draw(
+                bidx_row, ids, wf, strawf, sumf, size, alg, bid,
+                x, r, pos,
+            ),
             size,
         )
 
@@ -739,7 +853,9 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
             (done, slot, left, ftotal, mode, cur_row, domain, lftotal,
              depth, parent_r, out, out2) = st
             in_leaf = mode == LEAF
-            ids, wf, bsize, alg, bid = load_bucket(cur_row)
+            ids, wf, strawf, sumf, bsize, alg, bid = load_bucket(
+                cur_row
+            )
             # uniform buckets whose size divides numrep advance r with
             # stride numrep+1 (mapper.c:722-728) — per descent level
             if cm.has_uniform:
@@ -766,7 +882,8 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
             # leaf draws use the slot index
             pos = jnp.where(in_leaf, slot, jnp.int32(0))
             item = dispatch_draw(
-                cur_row, ids, wf, bsize, alg, bid, x, r, pos
+                cur_row, ids, wf, strawf, sumf, bsize, alg, bid,
+                x, r, pos,
             )
             empty = bsize == 0
             target = jnp.where(in_leaf, 0, ttype)
