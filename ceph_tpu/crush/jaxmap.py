@@ -42,6 +42,7 @@ from .types import (  # noqa: E402
     CRUSH_BUCKET_LIST,
     CRUSH_BUCKET_STRAW,
     CRUSH_BUCKET_STRAW2,
+    CRUSH_BUCKET_TREE,
     CRUSH_BUCKET_UNIFORM,
     CRUSH_ITEM_NONE,
     CRUSH_ITEM_UNDEF,
@@ -176,6 +177,10 @@ class CompiledMap:
     has_uniform: bool
     has_straw: bool
     has_list: bool
+    has_tree: bool
+    # tree buckets: (nb, 2*tree_nodes + 1) f32 nw_hi|nw_lo|start_n
+    tree_pack: jnp.ndarray | None
+    tree_nodes: int
     uniform_sz: int  # max uniform-bucket size (perm loop bound)
     bidx: tuple  # host-side (-1-id) -> row for TAKE resolution
     max_devices: int
@@ -205,10 +210,10 @@ def compile_map(cmap) -> CompiledMap:
             CRUSH_BUCKET_UNIFORM,
             CRUSH_BUCKET_STRAW,
             CRUSH_BUCKET_LIST,
+            CRUSH_BUCKET_TREE,
         ):
             raise UnsupportedMap(
-                f"bucket {b.id} alg {b.alg}: device kernel supports "
-                "straw2/uniform/straw/list buckets (tree → oracle)"
+                f"bucket {b.id} alg {b.alg}: unknown bucket alg"
             )
     nb = len(cmap.buckets)
     sz = max(max(b.size for b in cmap.buckets.values()), 1)
@@ -254,6 +259,40 @@ def compile_map(cmap) -> CompiledMap:
             if any(s >= 1 << 32 for s in b.sum_weights[: b.size]):
                 raise UnsupportedMap("list sum weight >= 2^32")
             sums[row, : b.size] = b.sum_weights[: b.size]
+        if b.alg == CRUSH_BUCKET_TREE and not b.node_weights:
+            raise UnsupportedMap(
+                f"tree bucket {b.id} missing node_weights"
+            )
+
+    # tree buckets: per-bucket node-weight tables + start node
+    has_tree = bool((algs == CRUSH_BUCKET_TREE).any())
+    tree_pack = None
+    tree_nodes = 0
+    if has_tree:
+        tree_nodes = max(
+            len(b.node_weights)
+            for b in cmap.buckets.values()
+            if b.alg == CRUSH_BUCKET_TREE
+        )
+        nw = np.zeros((nb, tree_nodes), dtype=np.int64)
+        start = np.zeros(nb, dtype=np.int64)
+        for row, b in enumerate(
+            sorted(cmap.buckets.values(), key=lambda b: -b.id)
+        ):
+            if b.alg != CRUSH_BUCKET_TREE:
+                continue
+            if any(w >= 1 << 32 for w in b.node_weights):
+                raise UnsupportedMap("tree node weight >= 2^32")
+            nw[row, : len(b.node_weights)] = b.node_weights
+            start[row] = len(b.node_weights) >> 1
+        tree_pack = np.concatenate(
+            [
+                (nw >> 16).astype(np.float32),
+                (nw & 0xFFFF).astype(np.float32),
+                start[:, None].astype(np.float32),
+            ],
+            axis=1,
+        )
 
     # choose_args → dense per-position weight/id tables.  The C only
     # consults args in the straw2 chooser (crush_bucket_choose,
@@ -350,6 +389,11 @@ def compile_map(cmap) -> CompiledMap:
         has_uniform=bool((algs == CRUSH_BUCKET_UNIFORM).any()),
         has_straw=bool((algs == CRUSH_BUCKET_STRAW).any()),
         has_list=bool((algs == CRUSH_BUCKET_LIST).any()),
+        has_tree=has_tree,
+        tree_pack=(
+            None if tree_pack is None else jnp.asarray(tree_pack)
+        ),
+        tree_nodes=tree_nodes,
         uniform_sz=int(
             sizes[algs == CRUSH_BUCKET_UNIFORM].max()
         )
@@ -616,13 +660,62 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
             jnp.where(jnp.arange(SZ) == win, ids, 0)
         ).astype(jnp.int32)
 
+    TN = max(cm.tree_nodes, 1)
+
+    def tree_draw(bidx_row, ids, bid, x, r):
+        """Tree chooser (bucket_tree_choose, mapper.c:195-222):
+        weighted descent of the implicit binary tree.  The C's
+        (hash32_4 * u64 weight) >> 32 exceeds f64's 2^53 exact range,
+        so it is computed as split integer arithmetic: with
+        hash = h1*2^16 + h0 and A = h1*w = a1*2^16 + a0,
+        t = a1 + floor((a0*2^16 + h0*w) / 2^32) — every intermediate
+        stays below 2^49."""
+        trow = _lookup(bidx_row, NB, cm.tree_pack)
+        nwf = trow[:TN].astype(jnp.float64) * 65536.0 + trow[
+            TN : 2 * TN
+        ].astype(jnp.float64)
+        start = jnp.round(trow[2 * TN]).astype(jnp.int32)
+
+        def node_w(n):
+            oh = (jnp.arange(TN) == n).astype(jnp.float64)
+            return jnp.sum(oh * nwf)
+
+        def body(_i, n):
+            frozen = (n & 1) == 1
+            w = node_w(n)
+            hv = _hash4(
+                jnp.uint32(x),
+                n.astype(jnp.uint32),
+                jnp.uint32(r),
+                bid.astype(jnp.uint32),
+            ).astype(jnp.float64)
+            h1 = jnp.floor(hv / 65536.0)
+            h0 = hv - h1 * 65536.0
+            A = h1 * w
+            a1 = jnp.floor(A / 65536.0)
+            a0 = A - a1 * 65536.0
+            t = a1 + jnp.floor(
+                (a0 * 65536.0 + h0 * w) / 4294967296.0
+            )
+            low = (n & -n) >> 1  # 2^(height-1)
+            left = n - low
+            nxt = jnp.where(t < node_w(left), left, n + low)
+            return jnp.where(frozen, n, nxt).astype(jnp.int32)
+
+        depth = max(TN.bit_length(), 1)
+        n = lax.fori_loop(0, depth, body, start)
+        slot = n >> 1
+        return jnp.sum(
+            jnp.where(jnp.arange(SZ) == slot, ids, 0)
+        ).astype(jnp.int32)
+
     def dispatch_draw(
         bidx_row, ids, wf, strawf, sumf, size, alg, bid, x, r, pos
     ):
         """crush_bucket_choose over already-loaded bucket data; the
-        perm/straw/list paths only compile into maps containing those
-        bucket algs, the choose_args path only into maps that carry
-        choose_args."""
+        perm/straw/list/tree paths only compile into maps containing
+        those bucket algs, the choose_args path only into maps that
+        carry choose_args."""
         if cm.args_pack is not None:
             hash_ids, awf = load_args(bidx_row, pos)
         else:
@@ -637,6 +730,9 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
         if cm.has_list:
             li = list_draw(ids, wf, sumf, size, bid, x, r)
             item = jnp.where(alg == CRUSH_BUCKET_LIST, li, item)
+        if cm.has_tree:
+            tr = tree_draw(bidx_row, ids, bid, x, r)
+            item = jnp.where(alg == CRUSH_BUCKET_TREE, tr, item)
         return item
 
     def bucket_draw(bidx_row, x, r, pos):

@@ -18,6 +18,7 @@ from ceph_tpu.crush.jaxmap import (
 from ceph_tpu.crush.types import (
     CRUSH_BUCKET_LIST,
     CRUSH_BUCKET_STRAW,
+    CRUSH_BUCKET_TREE,
     CRUSH_BUCKET_STRAW2,
     CRUSH_ITEM_NONE,
     Rule,
@@ -175,11 +176,11 @@ def test_firefly_stable0_matches_oracle():
 
 
 def test_unsupported_fallback():
-    from ceph_tpu.crush.types import CRUSH_BUCKET_TREE
-
-    m = CrushMap(tunables=JEWEL)
+    # every bucket alg now runs on device; legacy local-tries
+    # tunables remain the oracle-only configuration
+    m = CrushMap(tunables=Tunables.argonaut())
     root = m.add_bucket(
-        CRUSH_BUCKET_TREE, 3, [0, 1, 2], [0x10000] * 3
+        CRUSH_BUCKET_STRAW2, 3, [0, 1, 2], [0x10000] * 3
     )
     _add_two_rules(m, root, 0)
     with pytest.raises(UnsupportedMap):
@@ -201,12 +202,13 @@ def _legacy_map(alg):
 
 
 @pytest.mark.parametrize(
-    "alg", [CRUSH_BUCKET_STRAW, CRUSH_BUCKET_LIST]
+    "alg",
+    [CRUSH_BUCKET_STRAW, CRUSH_BUCKET_LIST, CRUSH_BUCKET_TREE],
 )
 def test_legacy_bucket_algs_match_oracle(alg):
-    """Legacy straw and list hierarchies run ON DEVICE, exact against
-    the golden-anchored oracle (VERDICT round-2 weak #5: these maps
-    previously fell back to the pure-Python oracle)."""
+    """Legacy straw/list/tree hierarchies run ON DEVICE, exact
+    against the golden-anchored oracle (VERDICT round-2 weak #5:
+    these maps previously fell back to the pure-Python oracle)."""
     m = _legacy_map(alg)
     cm = compile_map(m)
     for rule in (0, 1):
