@@ -5,9 +5,8 @@ over a thread pool (src/osd/OSDMapMapping.h:18-156); here the whole map
 compiles to dense arrays and ``crush_do_rule`` becomes a scalar-traced
 function vmapped over the PG batch: one device call maps a million PGs.
 
-Scope: straw2 + uniform bucket hierarchies (the algs the hammer+
-profiles allow, minus legacy list/straw — the modern default and the
-10k-OSD benchmark config are pure straw2), tunables with
+Scope: all five bucket algorithms (straw2/uniform/straw/list/tree),
+tunables with
 choose_local_tries == choose_local_fallback_tries == 0 (true of every
 profile since bobtail), rule programs of [SET_*...] TAKE CHOOSE[LEAF]
 EMIT groups.  Anything else raises UnsupportedMap and callers fall back
