@@ -91,7 +91,9 @@ class Connection:
             raise
         except concurrent.futures.TimeoutError as e:
             raise MessageError(f"call tid={msg.tid} timed out") from e
-        except Exception as e:
+        except (Exception, concurrent.futures.CancelledError) as e:
+            # CancelledError is a BaseException; shutdown()'s cancel-all
+            # must surface as MessageError in caller threads, not escape
             raise MessageError(
                 f"call tid={msg.tid} failed: {type(e).__name__}: {e}"
             ) from e
@@ -267,11 +269,17 @@ class Messenger:
 
         async def _dial():
             reader, writer = await asyncio.open_connection(host, port)
+            try:
+                return await _negotiate(reader, writer)
+            except BaseException:
+                writer.close()
+                raise
+
+        async def _negotiate(reader, writer):
             writer.write(BANNER)
             await writer.drain()
             peer = await reader.readexactly(len(BANNER))
             if peer != BANNER:
-                writer.close()
                 raise MessageError("banner mismatch")
             mode = await reader.readexactly(1)
             if mode == b"A":
@@ -279,7 +287,6 @@ class Messenger:
                 # challenge follows (CEPHX_V2 anti-replay)
                 challenge = await reader.readexactly(16)
                 if self.auth_client is None:
-                    writer.close()
                     raise MessageError(
                         "server requires cephx auth, no ticket held"
                     )
@@ -288,7 +295,6 @@ class Messenger:
                 await writer.drain()
                 plen = int.from_bytes(await reader.readexactly(4), "little")
                 if plen == 0:
-                    writer.close()
                     raise MessageError("cephx authorizer rejected")
                 proof = await reader.readexactly(plen)
                 from ..auth.cephx import AuthError
@@ -296,10 +302,8 @@ class Messenger:
                 try:
                     self.auth_client.verify_server(challenge, nonce, proof)
                 except AuthError as e:
-                    writer.close()
                     raise MessageError(f"server auth failed: {e}")
             elif mode != b"N":
-                writer.close()
                 raise MessageError("bad auth negotiation byte")
             conn = Connection(self, reader, writer, outgoing=True)
             self._conns.add(conn)
@@ -310,7 +314,7 @@ class Messenger:
             return self._run(_dial()).result(timeout)
         except MessageError:
             raise
-        except Exception as e:
+        except (Exception, concurrent.futures.CancelledError) as e:
             raise MessageError(
                 f"connect {host}:{port} failed: {e}"
             ) from e
@@ -324,6 +328,14 @@ class Messenger:
                 self._server.close()
             for conn in list(self._conns):
                 await conn._close()
+            # Cancel anything still in flight on this loop (dials that
+            # never completed, lingering read loops) so pytest exits with
+            # no "Task was destroyed but it is pending" warnings.
+            me = asyncio.current_task()
+            pending = [t for t in asyncio.all_tasks() if t is not me]
+            for t in pending:
+                t.cancel()
+            await asyncio.gather(*pending, return_exceptions=True)
 
         self._run(_stop()).result(10)
         self._loop.call_soon_threadsafe(self._loop.stop)
