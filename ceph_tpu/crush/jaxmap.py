@@ -25,7 +25,6 @@ u16 domain).
 from __future__ import annotations
 
 import collections
-import functools
 import math
 from dataclasses import dataclass
 
@@ -619,7 +618,9 @@ def _descent_steps(cm: CompiledMap, start_rows, ttype: int):
     return steps, found
 
 
-def _plan_groups(cm: CompiledMap, ruleno: int, result_max: int):
+def _plan_groups(
+    cm: CompiledMap, ruleno: int, result_max: int, spec_boost: int = 0
+):
     """Host-side pre-pass over a rule's groups: resolve TAKE rows,
     tries/tunables, and decide per group whether the speculative fast
     path applies (firstn, acyclic bounded-depth descent, single
@@ -696,17 +697,23 @@ def _plan_groups(cm: CompiledMap, ruleno: int, result_max: int):
         else:
             ntargets = max(len(domains), 1)
         p_retry = min(numrep / ntargets, 0.9)
-        spec = max(
-            2,
-            min(
-                _SPEC_TRIES,
-                math.ceil(
-                    math.log(1e-5 / max(numrep, 1))
-                    / math.log(max(p_retry, 1e-9))
-                )
-                - 1,
-            ),
-        )
+        if spec_boost:
+            # caller passed a non-trivial reweight vector: is_out()
+            # rejects add retry pressure the topology-derived estimate
+            # cannot see, so take the full speculation window
+            spec = _SPEC_TRIES
+        else:
+            spec = max(
+                2,
+                min(
+                    _SPEC_TRIES,
+                    math.ceil(
+                        math.log(1e-5 / max(numrep, 1))
+                        / math.log(max(p_retry, 1e-9))
+                    )
+                    - 1,
+                ),
+            )
         r0 = min(numrep + spec, numrep + tries - 1)
         fast = {
             "R0": r0,
@@ -729,7 +736,9 @@ def _plan_groups(cm: CompiledMap, ruleno: int, result_max: int):
     return plans
 
 
-def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
+def _make_rule_fn(
+    cm: CompiledMap, ruleno: int, result_max: int, spec_boost: int = 0
+):
     """Build the scalar-traced do_rule for one (map, rule, result_max).
 
     Returns ``rule_fn(x, weightv, row_pack, args_pack, tree_pack) ->
@@ -757,7 +766,7 @@ def _make_rule_fn(cm: CompiledMap, ruleno: int, result_max: int):
       nested loops.  Under vmap all lanes advance together, so
       wall-clock per batch is the maximum lane's total draw count.
     """
-    plans = _plan_groups(cm, ruleno, result_max)
+    plans = _plan_groups(cm, ruleno, result_max, spec_boost)
     total_tries, descend_once, vary_r_t, stable_t = cm.tunables
     NONE = jnp.int32(CRUSH_ITEM_NONE)
     UNDEF = jnp.int32(CRUSH_ITEM_UNDEF)
@@ -1867,11 +1876,13 @@ def _kernel_tables(cm: CompiledMap):
     return t
 
 
-def _batched(cm: CompiledMap, ruleno: int, result_max: int):
-    key = ("xs", cm.skey, ruleno, result_max)
+def _batched(
+    cm: CompiledMap, ruleno: int, result_max: int, spec_boost: int = 0
+):
+    key = ("xs", cm.skey, ruleno, result_max, spec_boost)
     fn = _kernel_cache_get(key)
     if fn is None:
-        rf = _make_rule_fn(cm, ruleno, result_max)
+        rf = _make_rule_fn(cm, ruleno, result_max, spec_boost)
         has_args = cm.args_pack is not None
         has_tree = cm.tree_pack is not None
 
@@ -1894,6 +1905,7 @@ def _batched_range(
     result_max: int,
     n: int,
     packed: bool = False,
+    spec_boost: int = 0,
 ):
     """Jitted contiguous-range variant: xs = lo + iota(n) is built ON
     DEVICE, so a bulk remap (osdmaptool --test-map-pgs shape) ships
@@ -1901,10 +1913,10 @@ def _batched_range(
     pipeline without host round-trips between dispatches.  With
     ``packed`` the results ship as int16 (-32768 encodes NONE) and
     counts as uint8 — half the device→host bytes on a bulk remap."""
-    key = ("rg", cm.skey, ruleno, result_max, n, packed)
+    key = ("rg", cm.skey, ruleno, result_max, n, packed, spec_boost)
     fn = _kernel_cache_get(key)
     if fn is None:
-        rf = _make_rule_fn(cm, ruleno, result_max)
+        rf = _make_rule_fn(cm, ruleno, result_max, spec_boost)
         has_args = cm.args_pack is not None
         has_tree = cm.tree_pack is not None
 
@@ -1976,6 +1988,19 @@ def apply_oracle_fallback(
     return res, counts
 
 
+def _spec_boost_for(weights) -> int:
+    """1 when the reweight vector meaningfully deviates from full-in
+    (is_out() rejects then drive extra retries the topology-sized
+    speculation window cannot predict), else 0."""
+    if weights is None:
+        return 0
+    w = np.asarray(weights)
+    if w.size == 0:
+        return 0
+    frac = np.count_nonzero(w != 0x10000) / w.size
+    return 1 if frac > 0.02 else 0
+
+
 def batch_do_rule(
     cm: CompiledMap,
     ruleno: int,
@@ -1994,9 +2019,9 @@ def batch_do_rule(
     else:
         xs_dev = jnp.asarray(np.asarray(xs, dtype=np.int32))
     wv = jnp.asarray(weights, dtype=jnp.int32)
-    res, counts, ok = _batched(cm, ruleno, result_max)(
-        xs_dev, wv, *_kernel_tables(cm)
-    )
+    res, counts, ok = _batched(
+        cm, ruleno, result_max, _spec_boost_for(weights)
+    )(xs_dev, wv, *_kernel_tables(cm))
     return apply_oracle_fallback(
         cm, ruleno, xs_dev, res, counts, ok, result_max, weights
     )
@@ -2023,13 +2048,15 @@ def batch_do_rule_range(
     if weights is None:
         weights = np.full(max(cm.max_devices, 1), 0x10000, np.int32)
     if packed and (
-        cm.max_devices >= 32768 or len(cm.bidx) >= 32768
+        cm.max_devices >= 32768
+        or len(cm.bidx) >= 32768
+        or result_max > 255
     ):
-        packed = False  # ids wouldn't fit the int16 wire form
+        packed = False  # ids/counts wouldn't fit the packed wire form
     wv = jnp.asarray(weights, dtype=jnp.int32)
-    return _batched_range(cm, ruleno, result_max, n, packed)(
-        jnp.int32(lo), wv, *_kernel_tables(cm)
-    )
+    return _batched_range(
+        cm, ruleno, result_max, n, packed, _spec_boost_for(weights)
+    )(jnp.int32(lo), wv, *_kernel_tables(cm))
 
 
 def make_chained_runner(
