@@ -174,3 +174,53 @@ class HashInfo:
         self.cumulative_shard_hashes = [
             0xFFFFFFFF for _ in self.cumulative_shard_hashes
         ]
+
+
+def rmw_range(
+    sinfo: StripeInfo, offset: int, length: int, old_size: int
+) -> tuple[int, int, set[int]]:
+    """The WritePlan head/tail analysis (ECBackend.cc:1858 start_rmw):
+    for a partial overwrite of [offset, offset+length), returns
+    (first_stripe, end_stripe, stripes_to_read) — only the partially
+    covered head/tail stripes that hold pre-existing bytes need
+    reading; fully-covered and beyond-EOF stripes encode fresh."""
+    sw = sinfo.stripe_width
+    start, span = sinfo.offset_len_to_stripe_bounds(offset, length)
+    first, end = start // sw, (start + span) // sw
+    old_stripes = sinfo.logical_to_next_stripe_offset(old_size) // sw
+    need: set[int] = set()
+    if offset % sw and first < old_stripes:
+        need.add(first)
+    if (offset + length) % sw and end - 1 < old_stripes:
+        need.add(end - 1)
+    return first, end, need
+
+
+def rmw_encode(
+    sinfo: StripeInfo,
+    ec,
+    offset: int,
+    data: bytes,
+    old_size: int,
+    read_stripes,
+) -> tuple[int, int, np.ndarray, dict[int, np.ndarray]]:
+    """Shared stripe-granular RMW assembly used by BOTH the store
+    pipeline (ECStore.write) and the daemon's EC write path
+    (osd/ec_pg.rmw_write_txns): read the needed stripes through the
+    caller's ``read_stripes(sorted_stripe_list) -> {stripe: bytes}``
+    (extent-cache-aware in the store, sub-op reads in the daemon),
+    overlay the new bytes, and re-encode just the covered range.
+    Returns (first_stripe, end_stripe, range_buffer, shards)."""
+    data = bytes(data)
+    sw = sinfo.stripe_width
+    first, end, need = rmw_range(sinfo, offset, len(data), old_size)
+    existing = read_stripes(sorted(need))
+    buf = np.zeros((end - first) * sw, dtype=np.uint8)
+    for s, stripe in existing.items():
+        buf[(s - first) * sw : (s - first + 1) * sw] = np.frombuffer(
+            bytes(stripe), dtype=np.uint8
+        )
+    lo = offset - first * sw
+    buf[lo : lo + len(data)] = np.frombuffer(data, dtype=np.uint8)
+    shards = encode(sinfo, ec, buf)
+    return first, end, buf, shards

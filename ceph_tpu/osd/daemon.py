@@ -111,7 +111,12 @@ from ..native import ceph_crc32c
 from ..store.ec_store import ECStore, HINFO_KEY
 from ..store.objectstore import MemStore, ObjectStore, StoreError, Transaction
 from ..store.remote import RemoteStore, ShardServer
-from .ec_pg import ECCodec, UnreachableStore, shard_write_txn
+from .ec_pg import (
+    ECCodec,
+    UnreachableStore,
+    rmw_write_txns,
+    shard_write_txn,
+)
 from .failure import HeartbeatTracker
 from .pg_log import (
     DELETE,
@@ -185,6 +190,14 @@ class PG:
         # erasure pools: cached (key, ECStore, conns) view over the
         # acting set; rebuilt when the interval/up-set/conns change
         self.ec_view: tuple | None = None
+        # True while every repop since the last successful peering
+        # committed on every live replica: the EC stripe-range RMW
+        # path requires it (a range write applied over a stale shard
+        # would corrupt it silently; the full-shard txn it replaces
+        # converged lagging replicas by construction).  Any
+        # primary-visible replica failure clears it until re-peering
+        # pushes the divergent objects.
+        self.repop_clean = False
         # scrub scheduling state (PG::ScrubberPasskey stamps,
         # src/osd/PG.h:231-240): last completed stamp + findings
         self.last_scrub = 0.0
@@ -382,8 +395,10 @@ class OSD(Dispatcher):
                     if changed or pg.state != "active":
                         if self._peer(pg, epoch):
                             pg.peered_interval = interval
+                            pg.repop_clean = True
                         else:
                             pg.peered_interval = None
+                            pg.repop_clean = False
                 else:
                     if changed:
                         # new interval: wait for the primary's
@@ -1366,6 +1381,7 @@ class OSD(Dispatcher):
             osd for osd in failed if self.monc.osdmap.is_up(osd)
         ]
         if live_failures:
+            pg.repop_clean = False
             # an up replica missed the write: re-peer to push it, and
             # make the client retry rather than acking a write that is
             # not on the full acting set (the reference blocks the op
@@ -1386,10 +1402,12 @@ class OSD(Dispatcher):
         one per-position transaction (shard + HashInfo + log entry +
         info) down the same MOSDRepOp path replicated pools use
         (ECBackend::submit_transaction under PrimaryLogPG,
-        ECBackend.cc:1502).  Partial writes read-modify-write the whole
-        object through the reconstructing read path — the daemon's
-        simplification of the stripe-granular RMW pipeline that
-        store/ec_store.py keeps."""
+        ECBackend.cc:1502).  Partial writes and appends go through the
+        stripe-granular RMW pipeline (ec_pg.rmw_write_txns wrapping
+        the shared ec/stripe.rmw_encode plan): only the covered
+        stripe range is read/encoded/shipped, gated on pg.repop_clean
+        so a range write can never land on a replica whose shard may
+        be stale."""
         if msg.reqid and msg.reqid in pg.reqid_cache:
             return pg.reqid_cache[msg.reqid][1]
         osdmap = self.monc.osdmap
@@ -1456,15 +1474,37 @@ class OSD(Dispatcher):
 
         if msg.op == OSD_OP_WRITEFULL:
             encode_all(msg.data)
-        elif msg.op == OSD_OP_APPEND:
-            encode_all(read_old() + msg.data)
-        elif msg.op == OSD_OP_WRITE:
-            old = read_old()
-            end = msg.offset + len(msg.data)
-            buf = bytearray(max(len(old), end))
-            buf[: len(old)] = old
-            buf[msg.offset : end] = msg.data
-            encode_all(bytes(buf))
+        elif msg.op in (OSD_OP_WRITE, OSD_OP_APPEND):
+            old_size = old_meta["size"] if existed else 0
+            # append IS a write at old_size — one branch, one gate
+            offset = (
+                old_size if msg.op == OSD_OP_APPEND else msg.offset
+            )
+            end = offset + len(msg.data)
+            partial = existed and (offset > 0 or end < old_size)
+            if (
+                partial
+                and offset <= old_size
+                and msg.data
+                and pg.repop_clean
+            ):
+                # stripe-granular RMW (ECBackend.cc:1858): only the
+                # covered stripe range is read/encoded/shipped, not
+                # the whole object
+                txns.update(
+                    rmw_write_txns(
+                        codec, ecs, pg.cid, store_oid,
+                        offset, msg.data,
+                        [pos for pos, _osd in present],
+                        old_size,
+                    )
+                )
+            else:
+                old = read_old()
+                buf = bytearray(max(len(old), end))
+                buf[: len(old)] = old
+                buf[offset:end] = msg.data
+                encode_all(bytes(buf))
         elif msg.op == OSD_OP_SETXATTR:
             if existed:
                 # touch first: the txn must apply unconditionally on a

@@ -31,7 +31,12 @@ import json
 import numpy as np
 
 from ..ec import ErasureCodeProfile, registry_instance
-from ..ec.stripe import HashInfo, StripeInfo, encode as stripe_encode
+from ..ec.stripe import (
+    HashInfo,
+    StripeInfo,
+    encode as stripe_encode,
+    rmw_encode,
+)
 from ..store.objectstore import ObjectStore, StoreError, Transaction
 from ..store.ec_store import HINFO_KEY
 
@@ -94,6 +99,52 @@ class ECCodec:
             "hashes": hinfo.cumulative_shard_hashes,
         }
         return {i: bytes(shards[i]) for i in range(self.n)}, meta
+
+
+def rmw_write_txns(
+    codec: ECCodec,
+    ecs,
+    cid: str,
+    oid: str,
+    offset: int,
+    data: bytes,
+    positions,
+    old_size: int,
+) -> dict[int, "Transaction"]:
+    """Stripe-granular partial overwrite for the daemon's EC write
+    path (start_rmw, src/osd/ECBackend.cc:1858): read ONLY the
+    partially-covered head/tail stripes that hold pre-existing bytes
+    (through ``ecs`` — the per-PG store view, so degraded stripes
+    reconstruct over real sub-op reads), re-encode just the covered
+    stripe range, and return one RANGE transaction per position (shard
+    bytes at the range's chunk offset + updated HashInfo) to ride the
+    MOSDRepOp logged-replication path.
+
+    Only ``(end-first)`` stripes' worth of shard bytes travel to each
+    replica — a 4KB overwrite of a multi-MB object ships ~one chunk
+    per shard, not the whole re-encoded object.  Matching the
+    reference's ec_overwrites semantics, the cumulative HashInfo is
+    invalidated (no "hashes" key): scrub falls back to the re-encode
+    consistency check."""
+    data = bytes(data)
+    sinfo = codec.sinfo
+    cs = sinfo.chunk_size
+    first, _end, _buf, shards = rmw_encode(
+        sinfo, codec.ec, offset, data, old_size,
+        lambda stripes: ecs.read_stripes(oid, stripes),
+    )
+    meta = {"size": max(old_size, offset + len(data))}
+    blob = json.dumps(meta).encode()
+    txns: dict[int, Transaction] = {}
+    for pos in positions:
+        txn = Transaction()
+        # touch first: the txn must apply unconditionally on a lagging
+        # replica that does not hold the object yet
+        txn.touch(cid, oid)
+        txn.write(cid, oid, first * cs, bytes(shards[pos]))
+        txn.setattr(cid, oid, HINFO_KEY, blob)
+        txns[pos] = txn
+    return txns
 
 
 def shard_write_txn(

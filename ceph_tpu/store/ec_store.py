@@ -36,7 +36,13 @@ import numpy as np
 
 from ..ec import ErasureCodeProfile, registry_instance
 from ..ec.interface import ErasureCodeError
-from ..ec.stripe import HashInfo, StripeInfo, decode_concat, encode as stripe_encode
+from ..ec.stripe import (
+    HashInfo,
+    StripeInfo,
+    decode_concat,
+    encode as stripe_encode,
+    rmw_encode,
+)
 from ..native import ceph_crc32c
 from .objectstore import MemStore, ObjectStore, StoreError, Transaction
 from .pg_util import ObjectOpQueue, ScrubResult
@@ -193,10 +199,6 @@ class ECStore:
             return 0
         sw = self.sinfo.stripe_width
         cs = self.sinfo.chunk_size
-        start, span = self.sinfo.offset_len_to_stripe_bounds(
-            offset, len(data)
-        )
-        first, end = start // sw, (start + span) // sw
         ticket = self._enter(name)
         try:
             try:
@@ -212,31 +214,26 @@ class ECStore:
                 # first (the wait_for_degraded_object barrier before
                 # ECBackend::submit_transaction)
                 self._recover_degraded(name, old_size)
-            old_stripes = (
-                self.sinfo.logical_to_next_stripe_offset(old_size) // sw
+            def read_cached(stripes: list[int]):
+                """ExtentCache first, shard reads for the rest (the
+                objects_read_async_no_cache hop inside start_rmw)."""
+                existing: dict[int, np.ndarray] = {}
+                to_read = []
+                for s in stripes:
+                    cached = self.extent_cache.get(name, s)
+                    if cached is not None:
+                        existing[s] = np.frombuffer(
+                            cached, dtype=np.uint8
+                        )
+                    else:
+                        to_read.append(s)
+                existing.update(self.read_stripes(name, to_read))
+                return existing
+
+            first, end, buf, shards = rmw_encode(
+                self.sinfo, self.ec, offset, data, old_size,
+                read_cached,
             )
-            need = set()
-            if offset % sw and first < old_stripes:
-                need.add(first)
-            if (offset + len(data)) % sw and end - 1 < old_stripes:
-                need.add(end - 1)
-            existing: dict[int, np.ndarray] = {}
-            to_read = []
-            for s in sorted(need):
-                cached = self.extent_cache.get(name, s)
-                if cached is not None:
-                    existing[s] = np.frombuffer(cached, dtype=np.uint8)
-                else:
-                    to_read.append(s)
-            existing.update(self._read_stripes(name, to_read))
-
-            buf = np.zeros((end - first) * sw, dtype=np.uint8)
-            for s, stripe in existing.items():
-                buf[(s - first) * sw : (s - first + 1) * sw] = stripe
-            lo = offset - first * sw
-            buf[lo : lo + len(data)] = np.frombuffer(data, dtype=np.uint8)
-
-            shards = stripe_encode(self.sinfo, self.ec, buf)
             new_meta = {"size": max(old_size, offset + len(data))}
             blob = json.dumps(new_meta).encode()
             for i, store in enumerate(self.stores):
@@ -278,7 +275,7 @@ class ECStore:
                 pass
             self._recover_locked(name, i)
 
-    def _read_stripes(
+    def read_stripes(
         self, name: str, stripes: list[int]
     ) -> dict[int, np.ndarray]:
         """Ranged stripe reads for RMW: data shards first, widening to
