@@ -1,0 +1,28 @@
+"""MDS — the metadata daemon tier over rados
+(src/mds/Server.cc + src/mds/Locker.cc + src/osdc/Journaler.cc,
+reduced to the load-bearing machinery; see docs/PARITY.md).
+
+Three pieces:
+
+- ``Journaler`` (journaler.py): the replayable metadata journal on
+  rados — a striped entry stream with a head object tracking
+  write/expire positions (src/osdc/Journaler.cc:1).
+- ``MDSDaemon`` (server.py): client sessions, a path-walked metadata
+  cache journaled ahead of lazy backing-store flushes, capability
+  grant/recall for coherent client caching, and mon-driven
+  active/standby failover (beacons through the monitor's command
+  plane; the MDSMonitor role).
+- ``MDSClient`` (client.py): the capability-aware mount — metadata
+  through the MDS session, file DATA striped straight to the data
+  pool with the real CephFS object naming, readdir/stat caching valid
+  exactly while the MDS-granted capability stands.
+
+The cap-free library-mode client (dirfrags-in-omap, single writer)
+remains at ceph_tpu.fs.CephFS.
+"""
+
+from .journaler import Journaler
+from .server import MDSDaemon
+from .client import MDSClient, MDSError
+
+__all__ = ["Journaler", "MDSDaemon", "MDSClient", "MDSError"]

@@ -627,6 +627,82 @@ def _cmd_config_dump(mon: Monitor, cmd: dict) -> MMonCommandReply:
     return MMonCommandReply(outb=json.dumps(mon.config_db))
 
 
+def _cmd_mds_beacon(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """MDSMonitor beacon handling (src/mon/MDSMonitor.cc reduced):
+    one active + standbys, stale-beacon failover.  The mdsmap lives
+    on the leader; a fresh leader rebuilds it from the next beacons
+    (deviation: not paxos-committed — documented in mds package)."""
+    name = cmd["name"]
+    addr = cmd["addr"]
+    m = getattr(mon, "mdsmap", None)
+    if m is None:
+        m = mon.mdsmap = {
+            "epoch": 0, "active": None, "standbys": [], "beacons": {},
+        }
+    now = time.time()
+    m["beacons"][name] = now
+    grace = getattr(mon, "mds_beacon_grace", 4.0)
+    entry = {"name": name, "addr": addr}
+    active = m["active"]
+    if active is None or active["name"] == name:
+        if active is None or active["addr"] != addr:
+            m["epoch"] += 1
+        m["active"] = entry
+        m["standbys"] = [
+            s for s in m["standbys"] if s["name"] != name
+        ]
+    elif now - m["beacons"].get(active["name"], 0) > grace:
+        # the active's beacons stopped: promote this daemon
+        m["active"] = entry
+        m["standbys"] = [
+            s for s in m["standbys"] if s["name"] != name
+        ]
+        m["epoch"] += 1
+    elif all(s["name"] != name for s in m["standbys"]):
+        m["standbys"].append(entry)
+        m["epoch"] += 1
+    state = "active" if m["active"]["name"] == name else "standby"
+    return MMonCommandReply(
+        rc=0,
+        outb=json.dumps({"state": state, "epoch": m["epoch"]}),
+    )
+
+
+def _cmd_mds_stat(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    m = getattr(mon, "mdsmap", None) or {
+        "epoch": 0, "active": None, "standbys": [],
+    }
+    return MMonCommandReply(
+        rc=0,
+        outb=json.dumps(
+            {
+                "epoch": m["epoch"],
+                "active": m["active"],
+                "standbys": m["standbys"],
+            }
+        ),
+    )
+
+
+def _cmd_mds_fail(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """Operator-forced failover: demote the active; the next standby
+    beacon claims the rank (its beacon sees active=None)."""
+    m = getattr(mon, "mdsmap", None)
+    if m is None or m["active"] is None:
+        return MMonCommandReply(rc=-2, outs="no active mds (-ENOENT)")
+    was = m["active"]["name"]
+    m["beacons"].pop(was, None)
+    if m["standbys"]:
+        m["active"] = m["standbys"].pop(0)
+    else:
+        m["active"] = None
+    m["epoch"] += 1
+    return MMonCommandReply(
+        rc=0, outs=f"failed mds {was}",
+        outb=json.dumps({"epoch": m["epoch"]}),
+    )
+
+
 _COMMANDS = {
     "status": _cmd_status,
     "osd down": _cmd_osd_down,
@@ -649,6 +725,9 @@ _COMMANDS = {
     "config set": _cmd_config_set,
     "config get": _cmd_config_get,
     "config dump": _cmd_config_dump,
+    "mds beacon": _cmd_mds_beacon,
+    "mds stat": _cmd_mds_stat,
+    "mds fail": _cmd_mds_fail,
 }
 
 

@@ -879,3 +879,61 @@ class MPGPull(Message):
             pgid=d.string(), epoch=d.u32(), oid=d.string(),
             shard=d.s32(),
         )
+
+
+@register_message
+@dataclass
+class MClientRequest(Message):
+    """FS client → MDS metadata op (MClientRequest: op name + JSON
+    args; src/messages/MClientRequest.h role).  ``reqid`` lets the
+    session dedup retries across reconnects."""
+
+    TYPE = 40
+    op: str = ""
+    args: str = "{}"
+    reqid: str = ""
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.op).string(self.args).string(self.reqid)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MClientRequest":
+        return cls(op=d.string(), args=d.string(), reqid=d.string())
+
+
+@register_message
+@dataclass
+class MClientReply(Message):
+    """MDS → client op reply (MClientReply role)."""
+
+    TYPE = 41
+    rc: int = 0
+    outs: str = ""
+    outb: str = "{}"
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.rc).string(self.outs).string(self.outb)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MClientReply":
+        return cls(rc=d.s32(), outs=d.string(), outb=d.string())
+
+
+@register_message
+@dataclass
+class MClientCaps(Message):
+    """Capability traffic between MDS and client (MClientCaps role):
+    the MDS revokes a session's cap on an inode before a conflicting
+    mutation commits; the client invalidates its cached state and
+    acks on the same tid."""
+
+    TYPE = 42
+    action: str = ""  # "revoke" | "ack"
+    ino: int = 0
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.action).s64(self.ino)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MClientCaps":
+        return cls(action=d.string(), ino=d.s64())

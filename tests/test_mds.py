@@ -1,0 +1,269 @@
+"""The MDS tier: sessions, journaled metadata, caps coherence,
+mon-driven failover (src/mds/Server.cc + Locker.cc +
+src/osdc/Journaler.cc acceptance walk, VERDICT round-3 item 4).
+
+The two headline scenarios:
+
+- two clients share a directory through capability recall (no
+  polling): the second client's conflicting mutation revokes the
+  first's cap BEFORE it commits, so the very next readdir refetches;
+- kill the active MDS mid-workload: the monitor promotes the standby
+  on beacon silence, the standby replays the journal tail (mutations
+  the dead active never flushed to the backing omap), and clients
+  recover by reconnecting.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.crush.builder import CrushMap
+from ceph_tpu.crush.types import CRUSH_BUCKET_STRAW2, Tunables
+from ceph_tpu.mds import Journaler, MDSClient, MDSDaemon
+from ceph_tpu.mon.monitor import Monitor
+from ceph_tpu.msg import Messenger
+from ceph_tpu.osd.daemon import OSD
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.rados import Rados
+
+
+def _base_map(n: int) -> OSDMap:
+    cmap = CrushMap(tunables=Tunables())
+    hosts = []
+    for h in range(n):
+        hosts.append(
+            cmap.add_bucket(
+                CRUSH_BUCKET_STRAW2, 1, [h], [0x10000],
+                name=f"host{h}",
+            )
+        )
+    cmap.add_bucket(
+        CRUSH_BUCKET_STRAW2, 3, hosts,
+        [cmap.buckets[b].weight for b in hosts], name="default",
+    )
+    cmap.add_simple_rule("rep", "default", "host", mode="firstn")
+    return OSDMap.build(cmap, n)
+
+
+class FSCluster:
+    """Monitor + OSDs + metadata/data pools + MDS daemons."""
+
+    def __init__(self, n_osd: int = 3):
+        self.mon = Monitor(_base_map(n_osd), min_reporters=2)
+        self.mon.mds_beacon_grace = 1.2  # fast failover for tests
+        self.mon_msgr = Messenger("mon")
+        self.mon_msgr.add_dispatcher(self.mon)
+        self.mon_addr = self.mon_msgr.bind()
+        self.osds: dict[int, OSD] = {}
+        for i in range(n_osd):
+            osd = OSD(i, tick_interval=0.2, heartbeat_grace=1.0)
+            osd.boot(*self.mon_addr)
+            self.osds[i] = osd
+        self.rados = Rados("fs-admin").connect(*self.mon_addr)
+        self.rados.pool_create("fsmeta", pg_num=4, size=2)
+        self.rados.pool_create("fsdata", pg_num=4, size=2)
+        self.mds: dict[str, MDSDaemon] = {}
+        self._radoses: list[Rados] = []
+        self.clients: list[MDSClient] = []
+
+    def start_mds(self, name: str, **kw) -> MDSDaemon:
+        r = Rados(f"mds-{name}").connect(*self.mon_addr)
+        self._radoses.append(r)
+        d = MDSDaemon(
+            name, r, "fsmeta", beacon_interval=0.3, **kw
+        )
+        self.mds[name] = d
+        return d
+
+    def kill_mds(self, name: str) -> None:
+        """Hard kill: no flush, no goodbye — the journal tail stays
+        unflushed, exactly what replay must recover."""
+        d = self.mds.pop(name)
+        d._stop.set()
+        d.msgr.shutdown()
+
+    def wait_active(self, name: str, timeout: float = 15.0) -> None:
+        deadline = time.monotonic() + timeout
+        d = self.mds[name]
+        while time.monotonic() < deadline:
+            if d.state == "active":
+                return
+            time.sleep(0.1)
+        raise AssertionError(f"mds {name} never became active")
+
+    def client(self, name: str) -> MDSClient:
+        r = Rados(f"fs-{name}").connect(*self.mon_addr)
+        self._radoses.append(r)
+        c = MDSClient(r, "fsdata", name=name)
+        self.clients.append(c)
+        return c
+
+    def shutdown(self) -> None:
+        for c in self.clients:
+            c.close()
+        for name in list(self.mds):
+            self.kill_mds(name)
+        for r in self._radoses:
+            r.shutdown()
+        self.rados.shutdown()
+        for osd in self.osds.values():
+            osd._stop.set()
+            osd._workq.put(None)
+            osd.messenger.shutdown()
+        self.mon_msgr.shutdown()
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = FSCluster()
+    c.start_mds("a")
+    c.wait_active("a")
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+def test_namespace_through_mds(cluster):
+    fs = cluster.client("ns")
+    fs.mkdir("/docs")
+    fs.mkdir("/docs/sub")
+    fs.create("/docs/hello.txt")
+    fs.write("/docs/hello.txt", 0, b"hello mds world")
+    assert fs.read("/docs/hello.txt") == b"hello mds world"
+    assert fs.readdir("/docs") == ["hello.txt", "sub"]
+    st = fs.stat("/docs/hello.txt")
+    assert st["type"] == "file" and st["size"] == 15
+    fs.rename("/docs/hello.txt", "/docs/sub/hi.txt")
+    assert fs.readdir("/docs") == ["sub"]
+    assert fs.read("/docs/sub/hi.txt") == b"hello mds world"
+    fs.truncate("/docs/sub/hi.txt", 5)
+    assert fs.read("/docs/sub/hi.txt") == b"hello"
+    fs.unlink("/docs/sub/hi.txt")
+    fs.rmdir("/docs/sub")
+    assert fs.readdir("/docs") == []
+
+
+def test_two_clients_share_dir_through_caps(cluster):
+    """Coherence by recall, not polling: B's create revokes A's dir
+    cap BEFORE it returns, so A's next readdir refetches."""
+    a = cluster.client("capA")
+    b = cluster.client("capB")
+    a.mkdir("/shared")
+    assert a.readdir("/shared") == []
+    a.stat("/shared")
+    # A now caches the dirfrag under its cap: a second readdir is
+    # served locally (no MDS round trip)
+    calls = []
+    orig = a._call
+
+    def counting(op, args, reqid=None):
+        calls.append(op)
+        return orig(op, args, reqid)
+
+    a._call = counting
+    assert a.readdir("/shared") == []
+    assert calls == [], "cached readdir should not hit the MDS"
+    a._call = orig
+
+    # B mutates the directory; its op completing implies A's cap was
+    # recalled and acked
+    b.create("/shared/from_b.txt")
+    assert a.recalls >= 1
+    assert a.readdir("/shared") == ["from_b.txt"]
+
+    # and the other direction: A creates, B (whose cap was granted by
+    # its own readdir) sees it immediately
+    assert b.readdir("/shared") == ["from_b.txt"]
+    a.create("/shared/from_a.txt")
+    assert b.readdir("/shared") == ["from_a.txt", "from_b.txt"]
+
+
+def test_stat_cache_invalidated_by_recall(cluster):
+    a = cluster.client("statA")
+    b = cluster.client("statB")
+    a.mkdir("/sized")
+    a.create("/sized/f")
+    assert a.stat("/sized/f")["size"] == 0
+    b.write("/sized/f", 0, b"x" * 4096)
+    # B's setattr revoked A's inode cap before committing
+    assert a.stat("/sized/f")["size"] == 4096
+    assert a.read("/sized/f") == b"x" * 4096
+
+
+def test_failover_replays_journal_and_clients_recover(cluster):
+    """Kill the active mid-workload: the standby replays the journal
+    tail (unflushed mutations) and clients ride over the failover."""
+    cluster.start_mds("b", flush_every=10_000)  # never auto-flush
+    fs = cluster.client("failover")
+    fs.mkdir("/work")
+    for i in range(8):
+        fs.create(f"/work/pre{i}")
+    fs.write("/work/pre0", 0, b"survives failover")
+
+    active = cluster.mds["a"]
+    assert active.state == "active"
+    cluster.kill_mds("a")
+
+    # mid-workload: these ops retry until the standby takes over
+    for i in range(4):
+        fs.create(f"/work/post{i}")
+
+    b = cluster.mds["b"]
+    assert b.state == "active"
+    assert b.replayed_entries > 0, "standby never replayed the journal"
+    want = sorted(
+        [f"pre{i}" for i in range(8)] + [f"post{i}" for i in range(4)]
+    )
+    fresh = cluster.client("checker")
+    assert fresh.readdir("/work") == want
+    assert fresh.read("/work/pre0") == b"survives failover"
+    assert fresh.stat("/work/pre0")["size"] == len(b"survives failover")
+
+
+def test_journaler_roundtrip_and_trim(cluster):
+    io = cluster.rados.open_ioctx("fsmeta")
+    j = Journaler(io, prefix="jt", object_size=64).load()
+    entries = [f"entry-{i}".encode() * (i + 1) for i in range(20)]
+    for e in entries:
+        j.append(e)
+    j.flush()
+    j2 = Journaler(io, prefix="jt", object_size=64).load()
+    assert list(j2.replay()) == entries
+    # trim past the first half; replay yields only the tail
+    half_pos = 0
+    j3 = Journaler(io, prefix="jt", object_size=64).load()
+    seen = 0
+    pos = j3.expire_pos
+    for e in j3.replay():
+        pos += 4 + len(e)
+        seen += 1
+        if seen == 10:
+            half_pos = pos
+            break
+    j3.trim(half_pos)
+    j4 = Journaler(io, prefix="jt", object_size=64).load()
+    assert list(j4.replay()) == entries[10:]
+
+
+def test_own_mutations_invalidate_own_caches(cluster):
+    """The MDS exempts the requester from cap recall, so
+    self-coherence is the client's own invalidation: a cached stat
+    must not survive one's own unlink, nor a cached listing one's
+    own create."""
+    fs = cluster.client("selfcoherent")
+    fs.mkdir("/own")
+    fs.create("/own/x")
+    assert fs.stat("/own/x")["type"] == "file"  # cached
+    assert fs.readdir("/own") == ["x"]  # cached
+    fs.create("/own/y")
+    assert fs.readdir("/own") == ["x", "y"]
+    fs.unlink("/own/x")
+    assert fs.readdir("/own") == ["y"]
+    with pytest.raises(Exception):
+        fs.stat("/own/x")
+    fs.rename("/own/y", "/own/z")
+    assert fs.readdir("/own") == ["z"]
+    assert fs.stat("/own/z")["type"] == "file"
