@@ -10,10 +10,12 @@ recovery paths without the messenger hop).
 """
 
 from .ec_store import ECStore, ScrubResult
+from .blockstore import BlockStore
 from .kstore import KStore
 from .objectstore import MemStore, ObjectStore, Transaction
 
 __all__ = [
+    "BlockStore",
     "ECStore",
     "KStore",
     "MemStore",

@@ -32,6 +32,12 @@ import threading
 
 from ..common.encoding import Decoder, DecodeError, Encoder
 from ..native import ceph_crc32c
+from .framed_log import (
+    append_frame,
+    replay_frames,
+    truncate_tail,
+    write_checkpoint,
+)
 from .objectstore import (
     MemStore,
     StoreError,
@@ -82,33 +88,16 @@ class KStore(MemStore):
             for op in txn.ops:
                 self._apply(st, op)
             with self._wal_lock:
-                self._wal.write(self._frame(txn))
-                self._wal.flush()
-                if self.sync:
-                    os.fsync(self._wal.fileno())
+                e = Encoder()
+                encode_transaction(e, txn)
+                append_frame(self._wal, e.getvalue(), self.sync)
             self._commit(st)
-
-    @staticmethod
-    def _frame(txn: Transaction) -> bytes:
-        e = Encoder()
-        encode_transaction(e, txn)
-        body = e.getvalue()
-        return (
-            len(body).to_bytes(4, "little")
-            + ceph_crc32c(0, body).to_bytes(4, "little")
-            + body
-        )
 
     def compact(self) -> None:
         """Checkpoint: snapshot full state, truncate the WAL."""
         with self._lock:
             blob = self._snapshot()
-            tmp = self.path / (_SNAP + ".tmp")
-            with open(tmp, "wb") as f:
-                f.write(blob)
-                f.flush()
-                os.fsync(f.fileno())
-            tmp.replace(self.path / _SNAP)
+            write_checkpoint(self.path / _SNAP, blob)
             with self._wal_lock:
                 self._wal.close()
                 self._wal = open(self.path / _WAL, "wb")
@@ -213,13 +202,7 @@ class KStore(MemStore):
             return
         raw = wal.read_bytes()
         pos = 0
-        replayed = 0
-        while pos + 8 <= len(raw):
-            blen = int.from_bytes(raw[pos : pos + 4], "little")
-            crc = int.from_bytes(raw[pos + 4 : pos + 8], "little")
-            body = raw[pos + 8 : pos + 8 + blen]
-            if len(body) < blen or ceph_crc32c(0, body) != crc:
-                break  # torn tail: a transaction died mid-write
+        for body, end in replay_frames(raw):
             try:
                 txn = decode_transaction(Decoder(body))
             except DecodeError:
@@ -232,9 +215,7 @@ class KStore(MemStore):
                 # e.g. mkcoll): possible only for WAL entries logged
                 # before the last compact raced a crash; skip it
                 pass
-            pos += 8 + blen
-            replayed += 1
+            pos = end
         if pos < len(raw):
             # drop the torn tail so future appends start clean
-            with open(wal, "r+b") as f:
-                f.truncate(pos)
+            truncate_tail(wal, pos)

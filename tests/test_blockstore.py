@@ -1,0 +1,238 @@
+"""BlockStore — the BlueStore-role extent store: allocator reuse,
+KV-indexed onodes, at-rest checksums verified on every read,
+compression through the plugin registry, fsck bit-rot detection, and
+the §5.4 SIGKILL gate (VERDICT round-3 item 5)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ceph_tpu.store import ECStore, Transaction
+from ceph_tpu.store.blockstore import ALLOC_UNIT, BlockStore
+from ceph_tpu.store.objectstore import StoreError
+
+
+def test_roundtrip_remount_and_full_surface(tmp_path):
+    s = BlockStore(tmp_path / "st")
+    s.queue_transaction(
+        Transaction()
+        .create_collection("c")
+        .touch("c", "o")
+        .write("c", "o", 0, b"hello world")
+        .setattr("c", "o", "k", b"v")
+        .omap_setkeys("c", "o", {"mk": b"mv", "mk2": b"mv2"})
+    )
+    s.queue_transaction(Transaction().write("c", "o", 6, b"bstore"))
+    assert s.read("c", "o") == b"hello bstore"
+    s.close()
+
+    s2 = BlockStore(tmp_path / "st")
+    assert s2.read("c", "o") == b"hello bstore"
+    assert s2.read("c", "o", 6, 3) == b"bst"
+    assert s2.getattr("c", "o", "k") == b"v"
+    assert s2.omap_get("c", "o") == {"mk": b"mv", "mk2": b"mv2"}
+    assert s2.omap_get_vals("c", "o", start_after="mk") == {
+        "mk2": b"mv2"
+    }
+    assert s2.list_objects("c") == ["o"]
+    assert s2.list_collections() == ["c"]
+    assert s2.stat("c", "o") == 12
+    assert s2.fsck() == []
+    s2.close()
+
+
+def test_sparse_truncate_clone_and_remove(tmp_path):
+    s = BlockStore(tmp_path / "st")
+    s.queue_transaction(Transaction().create_collection("c"))
+    # sparse write: hole before the data reads as zeros
+    s.queue_transaction(
+        Transaction().touch("c", "sp").write("c", "sp", 10000, b"tail")
+    )
+    assert s.read("c", "sp", 0, 8) == b"\0" * 8
+    assert s.read("c", "sp", 10000, 4) == b"tail"
+    # truncate down then up
+    s.queue_transaction(Transaction().write("c", "t", 0, b"x" * 9000))
+    s.queue_transaction(Transaction().truncate("c", "t", 5000))
+    assert s.stat("c", "t") == 5000
+    assert s.read("c", "t") == b"x" * 5000
+    s.queue_transaction(Transaction().truncate("c", "t", 7000))
+    assert s.read("c", "t") == b"x" * 5000 + b"\0" * 2000
+    # clone carries data + xattrs + omap
+    s.queue_transaction(
+        Transaction()
+        .setattr("c", "t", "a", b"1")
+        .omap_setkeys("c", "t", {"k": b"v"})
+    )
+    s.queue_transaction(Transaction().clone("c", "t", "t2"))
+    assert s.read("c", "t2") == s.read("c", "t")
+    assert s.getattr("c", "t2", "a") == b"1"
+    assert s.omap_get("c", "t2") == {"k": b"v"}
+    # remove frees space + omap
+    s.queue_transaction(Transaction().remove("c", "t"))
+    assert not s.exists("c", "t")
+    with pytest.raises(StoreError):
+        s.read("c", "t")
+    assert s.fsck() == []
+    s.close()
+
+
+def test_allocator_reuses_freed_extents(tmp_path):
+    s = BlockStore(tmp_path / "st")
+    s.queue_transaction(Transaction().create_collection("c"))
+    blob = os.urandom(64 * ALLOC_UNIT)
+    for round_ in range(6):
+        s.queue_transaction(
+            Transaction().touch("c", "big").write("c", "big", 0, blob)
+        )
+    dev_size = os.path.getsize(tmp_path / "st" / "block.dev")
+    # COW rewrites release the old extents back to the allocator:
+    # six rewrites must not burn six objects' worth of device space
+    assert dev_size <= 3 * len(blob), dev_size
+    assert s.fsck() == []
+    s.close()
+    # remount rebuilds the free map from the onode walk
+    s2 = BlockStore(tmp_path / "st")
+    frontier_before = s2.alloc.frontier
+    s2.queue_transaction(
+        Transaction().touch("c", "big").write("c", "big", 0, blob)
+    )
+    assert s2.alloc.frontier <= frontier_before + len(blob)
+    assert s2.read("c", "big") == blob
+    s2.close()
+
+
+def test_checksum_catches_bitrot_on_read_and_fsck(tmp_path):
+    s = BlockStore(tmp_path / "st")
+    s.queue_transaction(
+        Transaction()
+        .create_collection("c")
+        .write("c", "clean", 0, b"A" * 8192)
+        .write("c", "rot", 0, b"B" * 8192)
+    )
+    rot_blob = s._onode("c", "rot").blobs[0]
+    s.close()
+
+    # flip one byte inside the rotted object's extent
+    with open(tmp_path / "st" / "block.dev", "r+b") as f:
+        f.seek(rot_blob[2] + 100)
+        byte = f.read(1)
+        f.seek(rot_blob[2] + 100)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+    s2 = BlockStore(tmp_path / "st")
+    assert s2.read("c", "clean") == b"A" * 8192  # verified clean
+    with pytest.raises(StoreError, match="checksum"):
+        s2.read("c", "rot")
+    errors = s2.fsck()
+    assert any("checksum" in e and "c/rot" in e for e in errors)
+    assert not any("c/clean" in e for e in errors)
+    s2.close()
+
+
+def test_compression_through_plugin_registry(tmp_path):
+    s = BlockStore(tmp_path / "st", compression="zlib")
+    s.queue_transaction(Transaction().create_collection("c"))
+    compressible = b"the quick brown fox " * 4096  # ~80KB, repetitive
+    s.queue_transaction(
+        Transaction().write("c", "z", 0, compressible)
+    )
+    on = s._onode("c", "z")
+    assert any(b[4] == "zlib" for b in on.blobs), on.blobs
+    stored = sum(b[3] for b in on.blobs)
+    assert stored < len(compressible) // 2
+    assert s.read("c", "z") == compressible
+    assert s.fsck() == []
+    s.close()
+    # mounts (and reads back) under a DIFFERENT configuration
+    s2 = BlockStore(tmp_path / "st", compression="none")
+    assert s2.read("c", "z") == compressible
+    assert s2.fsck() == []
+    s2.close()
+
+
+def test_torn_kv_tail_discarded(tmp_path):
+    s = BlockStore(tmp_path / "st")
+    s.queue_transaction(
+        Transaction().create_collection("c").write("c", "a", 0, b"one")
+    )
+    s.queue_transaction(Transaction().write("c", "b", 0, b"two"))
+    s.close()
+    # tear the last KV WAL frame mid-body
+    wal = tmp_path / "st" / "kv.log"
+    raw = wal.read_bytes()
+    wal.write_bytes(raw[:-2])
+    s2 = BlockStore(tmp_path / "st")
+    assert s2.read("c", "a") == b"one"
+    assert not s2.exists("c", "b")  # torn commit never happened
+    s2.queue_transaction(Transaction().write("c", "b", 0, b"two!"))
+    assert s2.read("c", "b") == b"two!"
+    assert s2.fsck() == []
+    s2.close()
+
+
+def test_ec_store_over_blockstore(tmp_path):
+    """The storage stack composes: EC shards over extent stores."""
+    stores = [
+        BlockStore(tmp_path / f"sh{i}", sync=False) for i in range(5)
+    ]
+    ecs = ECStore(
+        plugin="jerasure",
+        profile={"technique": "reed_sol_van", "k": "3", "m": "2", "w": "8"},
+        stores=stores,
+    )
+    data = os.urandom(30000)
+    ecs.put("obj", data)
+    assert bytes(ecs.get("obj")) == data
+    assert ecs.scrub("obj").clean
+    for st in stores:
+        assert st.fsck() == []
+        st.close()
+
+
+_CRASH_WRITER = """
+import sys, time
+from ceph_tpu.store.blockstore import BlockStore
+from ceph_tpu.store import Transaction
+s = BlockStore(sys.argv[1])
+s.queue_transaction(Transaction().create_collection("c"))
+print("ready", flush=True)
+i = 0
+while True:
+    fill = bytes([i % 251 + 1])
+    s.queue_transaction(
+        Transaction().touch("c", f"o{i}").write("c", f"o{i}", 0, fill * 4096)
+    )
+    i += 1
+"""
+
+
+def test_kill_mid_transaction_remount_fsck_clean(tmp_path):
+    """SIGKILL a writer mid-commit; remount must fsck clean with
+    every object fully written or fully absent (the §5.4 gate on the
+    extent store)."""
+    path = str(tmp_path / "st")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CRASH_WRITER, path],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    assert proc.stdout.readline().strip() == "ready"
+    time.sleep(1.0)
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(10)
+
+    s = BlockStore(path)
+    names = s.list_objects("c")
+    assert names
+    for oid in names:
+        data = s.read("c", oid)  # checksum-verified
+        assert len(data) == 4096
+        assert set(data) == {data[0]}
+    assert s.fsck() == []
+    s.close()
