@@ -53,7 +53,10 @@ class CryptoKey:
         self.secret = secret if secret is not None else os.urandom(32)
 
     # -- sha256-ctr keystream + encrypt-then-mac ---------------------------
-    def _keystream(self, nonce: bytes, n: int) -> bytes:
+    def keystream(self, nonce: bytes, n: int) -> bytes:
+        """CTR keystream for ``nonce`` — the one cipher primitive,
+        shared by ticket sealing (random nonces) and the messenger's
+        secure wire mode (per-connection counters)."""
         out = bytearray()
         counter = 0
         while len(out) < n:
@@ -63,11 +66,21 @@ class CryptoKey:
             counter += 1
         return bytes(out[:n])
 
+    _keystream = keystream
+
+    @staticmethod
+    def xor(data: bytes, ks: bytes) -> bytes:
+        """Whole-buffer XOR via big-int ops (the byte-loop would cost
+        O(n) interpreter time per message on the wire hot path)."""
+        n = len(data)
+        return (
+            int.from_bytes(data, "little")
+            ^ int.from_bytes(ks[:n], "little")
+        ).to_bytes(n, "little")
+
     def encrypt(self, plain: bytes) -> bytes:
         nonce = os.urandom(16)
-        ct = bytes(
-            a ^ b for a, b in zip(plain, self._keystream(nonce, len(plain)))
-        )
+        ct = self.xor(plain, self.keystream(nonce, len(plain)))
         tag = hmac.new(self.secret, nonce + ct, hashlib.sha256).digest()
         return nonce + ct + tag
 
@@ -78,9 +91,7 @@ class CryptoKey:
         want = hmac.new(self.secret, nonce + ct, hashlib.sha256).digest()
         if not hmac.compare_digest(tag, want):
             raise AuthError("ciphertext authentication failed")
-        return bytes(
-            a ^ b for a, b in zip(ct, self._keystream(nonce, len(ct)))
-        )
+        return self.xor(ct, self.keystream(nonce, len(ct)))
 
     def hmac(self, data: bytes) -> bytes:
         return hmac.new(self.secret, data, hashlib.sha256).digest()
@@ -187,12 +198,13 @@ class CephxServiceHandler:
 
     def verify_authorizer(
         self, authorizer_blob: bytes, challenge: bytes
-    ) -> tuple[str, bytes]:
+    ) -> tuple[str, bytes, bytes]:
         """Check a client authorizer against THIS connection's
         challenge: decrypt the ticket with the rotating key, verify
         expiry and the session-key proof.  Returns
-        (entity, server_proof) — the proof lets the client
-        authenticate the server back."""
+        (entity, server_proof, session_key) — the proof lets the
+        client authenticate the server back; the session key keys the
+        secure (AEAD) wire mode."""
         d = Decoder(authorizer_blob)
         ticket_blob = d.bytes()
         nonce = d.bytes()
@@ -204,7 +216,11 @@ class CephxServiceHandler:
         want = session.hmac(b"authorizer" + challenge + nonce)
         if not hmac.compare_digest(proof, want):
             raise AuthError("bad session-key proof")
-        return ticket.entity, session.hmac(b"server" + challenge + nonce)
+        return (
+            ticket.entity,
+            session.hmac(b"server" + challenge + nonce),
+            ticket.session_key,
+        )
 
 
 class CephxClientHandler:

@@ -41,6 +41,69 @@ class Dispatcher:
         pass
 
 
+class SecureCtx:
+    """Per-connection AEAD state for secure wire mode (the
+    ProtocolV2 secure-mode role, src/msg/async/crypto_onwire.cc:1-309,
+    with the framework's sha256-CTR+HMAC cipher — CryptoKey, the same
+    implementation cephx tickets use — in the AES-GCM seat).
+
+    Keys derive from the cephx session key plus both handshake nonces
+    (fresh per connection); each direction gets its own key and an
+    implicit strictly-increasing counter — the counter is NOT on the
+    wire, so a spliced, replayed, or reordered record fails its MAC
+    and drops the connection."""
+
+    def __init__(self, session_key: bytes, challenge: bytes,
+                 nonce: bytes, outgoing: bool):
+        import hashlib
+        import hmac as hmac_mod
+
+        from ..auth.cephx import CryptoKey
+
+        conn_key = hmac_mod.new(
+            session_key, b"secure" + challenge + nonce, hashlib.sha256
+        ).digest()
+        c2s = CryptoKey(
+            hmac_mod.new(conn_key, b"c2s", hashlib.sha256).digest()
+        )
+        s2c = CryptoKey(
+            hmac_mod.new(conn_key, b"s2c", hashlib.sha256).digest()
+        )
+        self._send = c2s if outgoing else s2c
+        self._recv = s2c if outgoing else c2s
+        self.send_ctr = 0
+        self.recv_ctr = 0
+
+    def seal(self, frame: bytes) -> bytes:
+        from ..auth.cephx import CryptoKey
+
+        ctr8 = self.send_ctr.to_bytes(8, "little")
+        ct = CryptoKey.xor(
+            frame, self._send.keystream(ctr8, len(frame))
+        )
+        tag = self._send.hmac(ctr8 + ct)
+        self.send_ctr += 1
+        return len(ct).to_bytes(4, "little") + ct + tag
+
+    def unseal(self, ct: bytes, tag: bytes) -> bytes:
+        import hmac as hmac_mod
+
+        from ..auth.cephx import CryptoKey
+
+        ctr8 = self.recv_ctr.to_bytes(8, "little")
+        want = self._recv.hmac(ctr8 + ct)
+        if not hmac_mod.compare_digest(tag, want):
+            raise MessageError(
+                "secure frame authentication failed (tampered or "
+                "replayed) — dropping connection"
+            )
+        plain = CryptoKey.xor(
+            ct, self._recv.keystream(ctr8, len(ct))
+        )
+        self.recv_ctr += 1
+        return plain
+
+
 class Connection:
     """One framed peer link (AsyncConnection role)."""
 
@@ -58,6 +121,7 @@ class Connection:
         self._plock = threading.Lock()
         self._closed = False
         self._send_lock = asyncio.Lock()
+        self.secure: SecureCtx | None = None
 
     # -- sync API ----------------------------------------------------------
     def send(self, msg: Message) -> None:
@@ -123,17 +187,34 @@ class Connection:
                 )
         frame = msg.to_frame()
         async with self._send_lock:
+            # seal under the send lock: the implicit counter must
+            # match the on-wire record order
+            if self.secure is not None:
+                frame = self.secure.seal(frame)
             self._writer.write(frame)
             await self._writer.drain()
 
     async def _read_loop(self) -> None:
         try:
             while True:
-                header = await self._reader.readexactly(
-                    Message.HEADER_SIZE
-                )
-                mtype, tid, plen = Message.parse_header(header)
-                body = await self._reader.readexactly(plen + 4)
+                if self.secure is not None:
+                    clen = int.from_bytes(
+                        await self._reader.readexactly(4), "little"
+                    )
+                    ct = await self._reader.readexactly(clen)
+                    tag = await self._reader.readexactly(32)
+                    frame = self.secure.unseal(ct, tag)
+                    header = frame[: Message.HEADER_SIZE]
+                    mtype, tid, plen = Message.parse_header(header)
+                    body = frame[Message.HEADER_SIZE :]
+                    if len(body) != plen + 4:
+                        raise MessageError("secure frame length")
+                else:
+                    header = await self._reader.readexactly(
+                        Message.HEADER_SIZE
+                    )
+                    mtype, tid, plen = Message.parse_header(header)
+                    body = await self._reader.readexactly(plen + 4)
                 msg = Message.from_payload(
                     mtype,
                     tid,
@@ -185,7 +266,19 @@ class Messenger:
     auth).  Both None = AUTH_NONE, the reference's
     auth_cluster_required=none mode (AuthRegistry negotiation)."""
 
-    def __init__(self, name: str = "client", auth_server=None, auth_client=None):
+    def __init__(
+        self,
+        name: str = "client",
+        auth_server=None,
+        auth_client=None,
+        secure: bool = False,
+    ):
+        if secure and auth_server is None and auth_client is None:
+            raise ValueError(
+                "secure mode needs cephx (the session key is the "
+                "wire key)"
+            )
+        self.secure = secure
         self.name = name
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
@@ -250,6 +343,11 @@ class Messenger:
 
     def bind(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
         """Listen; returns the bound (host, port)."""
+        if self.secure and self.auth_server is None:
+            raise ValueError(
+                "secure listener needs auth_server (cephx) — it "
+                "would otherwise serve PLAINTEXT despite secure=True"
+            )
         self.start()
         self._sessions()  # listeners serve lossless-peer handshakes
 
@@ -265,6 +363,10 @@ class Messenger:
     def connect(
         self, host: str, port: int, timeout: float = 10.0
     ) -> Connection:
+        if self.secure and self.auth_client is None:
+            raise MessageError(
+                "secure dialer needs auth_client (cephx)"
+            )
         self.start()
 
         async def _dial():
@@ -282,7 +384,15 @@ class Messenger:
             if peer != BANNER:
                 raise MessageError("banner mismatch")
             mode = await reader.readexactly(1)
-            if mode == b"A":
+            if self.secure and mode != b"S":
+                # a secure-required dialer refuses the downgrade: an
+                # on-path attacker rewriting 'S' to 'A'/'N' must not
+                # yield a plaintext session
+                raise MessageError(
+                    "server did not offer secure mode (downgrade "
+                    "refused)"
+                )
+            if mode in (b"A", b"S"):
                 # server demands a cephx authorizer; its 16-byte
                 # challenge follows (CEPHX_V2 anti-replay)
                 challenge = await reader.readexactly(16)
@@ -306,6 +416,13 @@ class Messenger:
             elif mode != b"N":
                 raise MessageError("bad auth negotiation byte")
             conn = Connection(self, reader, writer, outgoing=True)
+            if mode == b"S":
+                conn.secure = SecureCtx(
+                    self.auth_client.session.secret,
+                    challenge,
+                    nonce,
+                    outgoing=True,
+                )
             self._conns.add(conn)
             self._loop.create_task(conn._read_loop())
             return conn
@@ -406,9 +523,15 @@ class Messenger:
             if peer != BANNER:
                 writer.close()
                 return
+            secure_ctx = None
             if self.auth_server is not None:
                 challenge = self.auth_server.make_challenge()
-                writer.write(b"A" + challenge)
+                # 'S' demands cephx AND switches the wire to sealed
+                # frames after the handshake (ProtocolV2 secure
+                # mode); 'A' is crc mode with cephx
+                writer.write(
+                    (b"S" if self.secure else b"A") + challenge
+                )
                 await writer.drain()
                 blen = int.from_bytes(
                     await asyncio.wait_for(reader.readexactly(4), 10),
@@ -420,7 +543,7 @@ class Messenger:
                 from ..auth.cephx import AuthError
 
                 try:
-                    peer_entity, proof = (
+                    peer_entity, proof, session_key = (
                         self.auth_server.verify_authorizer(
                             blob, challenge
                         )
@@ -435,6 +558,15 @@ class Messenger:
                     len(proof).to_bytes(4, "little") + proof
                 )
                 await writer.drain()
+                if self.secure:
+                    from ..common.encoding import Decoder as _D
+
+                    d = _D(blob)
+                    d.bytes()  # ticket blob
+                    nonce = d.bytes()  # the client's handshake nonce
+                    secure_ctx = SecureCtx(
+                        session_key, challenge, nonce, outgoing=False
+                    )
             else:
                 writer.write(b"N")
                 await writer.drain()
@@ -442,6 +574,7 @@ class Messenger:
             writer.close()
             return
         conn = Connection(self, reader, writer, outgoing=False)
+        conn.secure = secure_ctx
         conn.peer_entity = peer_entity
         self._conns.add(conn)
         await conn._read_loop()

@@ -201,15 +201,20 @@ class _Allocator:
 
         need = _round_up(max(length, 1))
         i = bisect.bisect_left(self.free, [off, need])
-        self.free.insert(i, [off, need])
-        # coalesce with neighbours
-        merged: list[list[int]] = []
-        for run in self.free:
-            if merged and merged[-1][0] + merged[-1][1] == run[0]:
-                merged[-1][1] += run[1]
-            else:
-                merged.append(run)
-        self.free = merged
+        # coalesce with the immediate neighbours only — the list is
+        # sorted and disjoint, so nothing further can touch the run
+        if i > 0 and self.free[i - 1][0] + self.free[i - 1][1] == off:
+            self.free[i - 1][1] += need
+            j = i - 1
+        else:
+            self.free.insert(i, [off, need])
+            j = i
+        if (
+            j + 1 < len(self.free)
+            and self.free[j][0] + self.free[j][1] == self.free[j + 1][0]
+        ):
+            self.free[j][1] += self.free[j + 1][1]
+            del self.free[j + 1]
 
     def rebuild(self, used: list[tuple[int, int]]) -> None:
         """Free map = complement of the used extents."""

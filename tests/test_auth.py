@@ -40,7 +40,7 @@ def test_ticket_flow_and_mutual_auth():
     client.handle_response(svc.issue_ticket("client.admin"))
     challenge = svc.make_challenge()
     blob, nonce = client.build_authorizer(challenge)
-    entity, proof = svc.verify_authorizer(blob, challenge)
+    entity, proof, _skey = svc.verify_authorizer(blob, challenge)
     assert entity == "client.admin"
     client.verify_server(challenge, nonce, proof)  # mutual
     with pytest.raises(AuthError):
