@@ -34,6 +34,8 @@ import time
 
 from ..mon.monitor import MonClient
 from ..msg import Messenger
+from ..msg.message import MMgrReport
+from ..msg.messenger import Dispatcher
 
 __all__ = ["Manager", "MgrModule"]
 
@@ -67,21 +69,55 @@ class MgrModule:
         pass
 
 
-class Manager:
-    """The mgr daemon: mon session + module host (Mgr.cc)."""
+class Manager(Dispatcher):
+    """The mgr daemon: mon session + module host (Mgr.cc) + the
+    daemon-stats plane (DaemonServer.cc role): daemons discover the
+    mgr through the monitor ("mgr beacon"/"mgr stat") and push
+    MMgrReport perf dumps to its messenger; modules and the
+    prometheus exporter read them via get("daemon_perf")."""
 
-    def __init__(self, modules: list[type[MgrModule]] | None = None):
+    def __init__(
+        self,
+        modules: list[type[MgrModule]] | None = None,
+        name: str = "x",
+    ):
+        self.name = name
         self.messenger = Messenger("mgr")
         self.monc = MonClient(self.messenger, whoami=-2)
         self.module_options: dict[str, dict] = {}
         self._module_types = list(
             modules
             if modules is not None
-            else [BalancerModule, PrometheusModule, StatusModule]
+            else [
+                BalancerModule,
+                PrometheusModule,
+                StatusModule,
+                PgAutoscalerModule,
+            ]
         )
         self.modules: dict[str, MgrModule] = {}
         self._ticker: threading.Thread | None = None
         self._stop = threading.Event()
+        # DaemonServer role: inbound perf reports, daemon -> (ts, dump)
+        self.daemon_perf: dict[str, tuple[float, dict]] = {}
+        self._perf_lock = threading.Lock()
+        self.messenger.add_dispatcher(self)
+        self.addr: str | None = None
+
+    # -- MMgrReport ingestion (DaemonServer::handle_report) ----------------
+    def ms_dispatch(self, conn, msg) -> bool:
+        if not isinstance(msg, MMgrReport):
+            return False
+        try:
+            dump = json.loads(msg.perf)
+        except ValueError:
+            return True
+        with self._perf_lock:
+            self.daemon_perf[msg.daemon] = (time.time(), dump)
+        return True
+
+    def ms_handle_reset(self, conn) -> None:
+        pass
 
     def set_module_option(self, module: str, key: str, value) -> None:
         self.module_options.setdefault(module, {})[key] = value
@@ -89,7 +125,10 @@ class Manager:
     def start(self, mon_addrs) -> None:
         if isinstance(mon_addrs, tuple):
             mon_addrs = [mon_addrs]
+        host, port = self.messenger.bind()
+        self.addr = f"{host}:{port}"
         self.monc.connect_any(mon_addrs)
+        self._beacon()
         for mtype in self._module_types:
             mod = mtype(self)
             self.modules[mod.NAME] = mod
@@ -97,6 +136,18 @@ class Manager:
             target=self._tick_loop, name="mgr.tick", daemon=True
         )
         self._ticker.start()
+
+    def _beacon(self) -> None:
+        try:
+            self.monc.command(
+                {
+                    "prefix": "mgr beacon",
+                    "name": self.name,
+                    "addr": self.addr,
+                }
+            )
+        except Exception:  # noqa: BLE001 — beacons retry on the tick
+            pass
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -110,8 +161,12 @@ class Manager:
         self.messenger.shutdown()
 
     def _tick_loop(self) -> None:
+        last_beacon = 0.0
         while not self._stop.wait(0.2):
             now = time.monotonic()
+            if now - last_beacon > 2.0:
+                last_beacon = now
+                self._beacon()
             for mod in self.modules.values():
                 if now - mod._last_tick < mod.TICK_EVERY:
                     continue
@@ -153,6 +208,19 @@ class Manager:
                     pid: p.pg_num for pid, p in m.pools.items()
                 },
             }
+        if what == "daemon_perf":
+            cutoff = time.time() - 30.0
+            with self._perf_lock:
+                for d in [
+                    d
+                    for d, (ts, _dump) in self.daemon_perf.items()
+                    if ts < cutoff
+                ]:
+                    del self.daemon_perf[d]  # dead daemon: stop
+                    # exporting a frozen, live-looking series
+                return {
+                    d: dump for d, (_ts, dump) in self.daemon_perf.items()
+                }
         if what == "df":
             return {
                 "pools": [
@@ -318,6 +386,37 @@ class PrometheusModule(MgrModule):
             )
         pg = self.get("pg_summary")
         metric("ceph_pg_total", pg["num_pgs"], "total pgs")
+        # per-daemon series from MMgrReport perf dumps (the
+        # DaemonServer -> exporter plane): plain counters become
+        # gauges, avgcount/sum pairs become _count/_sum pairs
+        first_perf = True
+        for daemon, dump in sorted(
+            (self.get("daemon_perf") or {}).items()
+        ):
+            for cname, val in sorted(dump.items()):
+                base = "ceph_daemon_" + cname.replace(".", "_")
+                labels = {"ceph_daemon": daemon}
+                if isinstance(val, dict) and "avgcount" in val:
+                    metric(
+                        base + "_count",
+                        val["avgcount"],
+                        "per-daemon perf counters"
+                        if first_perf
+                        else None,
+                        labels=labels,
+                    )
+                    metric(base + "_sum", val["sum"], labels=labels)
+                    first_perf = False
+                elif isinstance(val, (int, float)):
+                    metric(
+                        base,
+                        val,
+                        "per-daemon perf counters"
+                        if first_perf
+                        else None,
+                        labels=labels,
+                    )
+                    first_perf = False
         for entry in self.get("df")["pools"]:
             metric(
                 "ceph_pool_pg_num",
@@ -328,3 +427,73 @@ class PrometheusModule(MgrModule):
                 labels={"pool": entry["name"]},
             )
         return "\n".join(out) + "\n"
+
+
+class PgAutoscalerModule(MgrModule):
+    """pg_num autoscaling (src/pybind/mgr/pg_autoscaler/module.py
+    reduced): per replicated pool, the ideal pg count is the power of
+    two nearest target_pgs_per_osd * in-osds / (pools * size); an
+    undersized pool gets a recommendation, and in mode "on" the
+    module commits the increase through "osd pool set pg_num"
+    (primaries split by stable_mod re-homing when they observe the
+    map).  Erasure pools are skipped (split unsupported there)."""
+
+    NAME = "pg_autoscaler"
+    TICK_EVERY = 1.0
+
+    def __init__(self, mgr: "Manager"):
+        super().__init__(mgr)
+        self.recommendations: dict[str, dict] = {}
+        self.applied = 0
+
+    def _ideal(self, m, pool) -> int:
+        target_per_osd = int(
+            self.get_module_option("target_pgs_per_osd", 32)
+        )
+        num_in = max(
+            1,
+            sum(
+                1
+                for o in range(m.max_osd)
+                if m.exists(o) and m.osd_weight[o] > 0
+            ),
+        )
+        npools = max(1, len(m.pools))
+        raw = target_per_osd * num_in / (npools * max(pool.size, 1))
+        ideal = 1
+        while ideal * 2 <= raw:
+            ideal *= 2
+        return max(ideal, pool.pg_num)
+
+    def serve(self) -> None:
+        m = self.get("osd_map")
+        if m is None:
+            return
+        from ..crush.types import PG_POOL_TYPE_ERASURE
+
+        for pid, pool in list(m.pools.items()):
+            if pool.type == PG_POOL_TYPE_ERASURE:
+                continue
+            ideal = self._ideal(m, pool)
+            name = m.pool_names.get(pid, str(pid))
+            if ideal > pool.pg_num:
+                self.recommendations[name] = {
+                    "current": pool.pg_num,
+                    "ideal": ideal,
+                }
+                if self.get_module_option("mode", "warn") == "on":
+                    # one doubling per tick: bounded splitting churn,
+                    # the reference's max_misplaced throttling role
+                    step = min(ideal, pool.pg_num * 2)
+                    reply = self.mon_command(
+                        {
+                            "prefix": "osd pool set",
+                            "pool": name,
+                            "var": "pg_num",
+                            "val": str(step),
+                        }
+                    )
+                    if reply.rc == 0:
+                        self.applied += 1
+            else:
+                self.recommendations.pop(name, None)

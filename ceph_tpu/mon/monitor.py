@@ -703,6 +703,79 @@ def _cmd_mds_fail(mon: Monitor, cmd: dict) -> MMonCommandReply:
     )
 
 
+def _cmd_mgr_beacon(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """MgrMonitor beacon (src/mon/MgrMonitor.cc reduced): one active
+    mgr whose address daemons discover to push MMgrReports."""
+    m = getattr(mon, "mgrmap", None)
+    if m is None:
+        m = mon.mgrmap = {"epoch": 0, "active": None}
+    entry = {"name": cmd["name"], "addr": cmd["addr"]}
+    if m["active"] != entry:
+        m["active"] = entry
+        m["epoch"] += 1
+    return MMonCommandReply(
+        rc=0, outb=json.dumps({"epoch": m["epoch"]})
+    )
+
+
+def _cmd_mgr_stat(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    m = getattr(mon, "mgrmap", None) or {"epoch": 0, "active": None}
+    return MMonCommandReply(rc=0, outb=json.dumps(m))
+
+
+def _cmd_pool_set(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """osd pool set <pool> pg_num <n> (OSDMonitor::prepare_command
+    pg_num path): increase-only; primaries split their PGs when they
+    observe the new map (object re-homing by stable_mod)."""
+    name = cmd["pool"]
+    var = cmd.get("var", "")
+    pool_id = None
+    for pid, pname in mon.osdmap.pool_names.items():
+        if pname == name:
+            pool_id = pid
+            break
+    if pool_id is None:
+        return MMonCommandReply(rc=-2, outs=f"no pool {name!r} (-ENOENT)")
+    if var != "pg_num":
+        return MMonCommandReply(rc=-22, outs=f"cannot set {var!r} (-EINVAL)")
+    val = int(cmd["val"])
+    pool = mon.osdmap.pools[pool_id]
+    if val < pool.pg_num:
+        return MMonCommandReply(
+            rc=-22, outs="pg_num cannot shrink (-EINVAL)"
+        )
+    if val == pool.pg_num:
+        return MMonCommandReply(rc=0, outs="no change")
+    if pool.type == PG_POOL_TYPE_ERASURE:
+        return MMonCommandReply(
+            rc=-95,
+            outs="pg_num change on erasure pools unsupported "
+            "(-EOPNOTSUPP)",
+        )
+    if pool.snap_seq or getattr(pool, "snaps", None):
+        # splitting migrates heads through the client op path; snap
+        # clones have no such path and would strand in the parent
+        return MMonCommandReply(
+            rc=-95,
+            outs="pg_num change on pools with snapshots unsupported "
+            "(-EOPNOTSUPP)",
+        )
+    import copy as _copy
+
+    newp = _copy.deepcopy(pool)
+    newp.pg_num = val
+    newp.pgp_num = val
+    newp.last_change = mon.osdmap.epoch + 1
+    inc = mon.pending()
+    inc.new_pools[pool_id] = newp
+    epoch = mon.commit(inc)
+    return MMonCommandReply(
+        rc=0,
+        outs=f"set pool {name} pg_num to {val}",
+        outb=json.dumps({"epoch": epoch}),
+    )
+
+
 _COMMANDS = {
     "status": _cmd_status,
     "osd down": _cmd_osd_down,
@@ -728,6 +801,9 @@ _COMMANDS = {
     "mds beacon": _cmd_mds_beacon,
     "mds stat": _cmd_mds_stat,
     "mds fail": _cmd_mds_fail,
+    "mgr beacon": _cmd_mgr_beacon,
+    "mgr stat": _cmd_mgr_stat,
+    "osd pool set": _cmd_pool_set,
 }
 
 

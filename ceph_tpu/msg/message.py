@@ -937,3 +937,22 @@ class MClientCaps(Message):
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MClientCaps":
         return cls(action=d.string(), ino=d.s64())
+
+
+@register_message
+@dataclass
+class MMgrReport(Message):
+    """Daemon → mgr perf-counter report (src/messages/MMgrReport.h
+    role): the daemon name plus a JSON perf dump, pushed on the
+    daemon's tick so the mgr's stats plane sees live counters."""
+
+    TYPE = 43
+    daemon: str = ""
+    perf: str = "{}"
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.daemon).string(self.perf)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MMgrReport":
+        return cls(daemon=d.string(), perf=d.string())

@@ -82,7 +82,9 @@ from ..msg import (
     MPGQuery,
     MPing,
 )
+from ..common.perf_counters import PerfCountersBuilder
 from ..msg.message import (
+    MMgrReport,
     OSD_OP_APPEND,
     OSD_OP_CALL,
     OSD_OP_DELETE,
@@ -253,6 +255,22 @@ class OSD(Dispatcher):
         self.recovery_max_active = max(1, recovery_max_active)
         self._recovery_active = 0
         self.recovery_active_peak = 0  # high-water mark (perf gauge)
+        # daemon perf counters (l_osd_* role): pushed to the mgr as
+        # MMgrReport on the tick (the DaemonServer stats plane)
+        self.perf = (
+            PerfCountersBuilder(f"osd.{whoami}")
+            .add_u64_counter("op", "client ops")
+            .add_u64_counter("op_r", "client reads")
+            .add_u64_counter("op_w", "client mutations")
+            .add_time_avg("op_latency", "client op latency")
+            .add_u64_gauge("numpg", "hosted pgs")
+            .add_u64_gauge("recovery_active", "in-flight recovery pushes")
+            .create_perf_counters()
+        )
+        self._mgr_addr: str | None = None
+        self._mgr_conn = None
+        self._mgr_addr_checked = 0.0
+        self._splitting: set[str] = set()
         self._recovery_lock = threading.Lock()
         self._scrubbing: set[str] = set()
         self.log_keep = 128  # pg_log length bound (osd_min_pg_log_entries role)
@@ -292,6 +310,12 @@ class OSD(Dispatcher):
             daemon=True,
         )
         self._ticker.start()
+        self._mgr_reporter = threading.Thread(
+            target=self._mgr_report_loop,
+            name=f"osd.{self.whoami}.mgrreport",
+            daemon=True,
+        )
+        self._mgr_reporter.start()
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -399,6 +423,14 @@ class OSD(Dispatcher):
                         else:
                             pg.peered_interval = None
                             pg.repop_clean = False
+                    if (
+                        pg.state == "active"
+                        and not self._is_ec(pg)
+                        and self._pg_num_grew(pg)
+                    ):
+                        # pg_num grew: re-home objects whose
+                        # stable_mod slot moved (PG splitting)
+                        self._workq.put(("split", pg.pgid, epoch))
                 else:
                     if changed:
                         # new interval: wait for the primary's
@@ -835,6 +867,21 @@ class OSD(Dispatcher):
 
     # -- client op path (primary) ------------------------------------------
     def _handle_op(self, conn: Connection, msg: MOSDOp) -> None:
+        t0 = time.perf_counter()
+        try:
+            self._handle_op_inner(conn, msg)
+        finally:
+            self.perf.inc("op")
+            if msg.op in (
+                OSD_OP_READ, OSD_OP_STAT, OSD_OP_GETXATTR,
+                OSD_OP_OMAPGET, OSD_OP_LIST,
+            ):
+                self.perf.inc("op_r")
+            else:
+                self.perf.inc("op_w")
+            self.perf.tinc("op_latency", time.perf_counter() - t0)
+
+    def _handle_op_inner(self, conn: Connection, msg: MOSDOp) -> None:
         epoch = self.monc.epoch
         pg = self.pgs.get(msg.pgid)
         reply = MOSDOpReply(tid=msg.tid, epoch=epoch)
@@ -843,6 +890,19 @@ class OSD(Dispatcher):
         ):
             reply.ok = False
             reply.error = f"not primary for pg {msg.pgid} (-EAGAIN)"
+            conn.send(reply)
+            return
+        pool = self._pool_of(pg)
+        if pool is not None and 0 < msg.epoch < pool.last_change:
+            # the pool changed (e.g. pg_num split) after the client's
+            # map: a misdirected write would land in a PG the rest of
+            # the cluster no longer consults for this object
+            # (OSD::handle_op's misdirected check)
+            reply.ok = False
+            reply.error = (
+                f"client map epoch {msg.epoch} predates pool change "
+                f"{pool.last_change}; refresh map (-EAGAIN)"
+            )
             conn.send(reply)
             return
         store_oid = OBJ_PREFIX + msg.oid
@@ -1980,6 +2040,38 @@ class OSD(Dispatcher):
                     self._apply_activate(item[1], item[2])
                 elif kind == "pull":
                     self._handle_pull(item[1], item[2])
+                elif kind == "split":
+                    pg = self.pgs.get(item[1])
+                    if (
+                        pg is not None
+                        and pg.primary == self.whoami
+                        and pg.state == "active"
+                        and item[1] not in self._splitting
+                    ):
+                        # the scan blocks on PEER primaries (who may
+                        # be splitting toward us at the same moment):
+                        # a side thread keeps this worker serving ops,
+                        # breaking the mutual-starvation cycle; local
+                        # mutations marshal back via _on_worker
+                        self._splitting.add(item[1])
+
+                        def run(pg=pg, epoch=item[2], pgid=item[1]):
+                            try:
+                                self._split_scan(pg, epoch)
+                            finally:
+                                self._splitting.discard(pgid)
+
+                        threading.Thread(
+                            target=run,
+                            name=f"osd.{self.whoami}.split",
+                            daemon=True,
+                        ).start()
+                elif kind == "splitcall":
+                    _k, fn, fut = item
+                    try:
+                        fut.set_result(fn())
+                    except Exception as e:  # noqa: BLE001
+                        fut.set_exception(e)
                 elif kind == "scrub":
                     pg = self.pgs.get(item[1])
                     try:
@@ -2001,6 +2093,194 @@ class OSD(Dispatcher):
         peers.discard(self.whoami)
         peers.discard(CRUSH_ITEM_NONE)  # EC holes are not peers
         return peers
+
+    def _mgr_report_loop(self) -> None:
+        """Dedicated thread: mgr discovery + MMgrReport pushes must
+        never stall the tick (a slow/unreachable mgr would otherwise
+        delay heartbeat pings past the grace and flap this OSD)."""
+        while not self._stop.wait(1.0):
+            try:
+                self._report_to_mgr()
+            except Exception:  # noqa: BLE001 — reporting best-effort
+                pass
+
+    def _report_to_mgr(self) -> None:
+        """Push a perf dump to the mgr (MMgrReport): discover the
+        active mgr through the monitor at a slow cadence, keep one
+        cached connection, drop it on any failure."""
+        now = time.monotonic()
+        if self._mgr_addr is None and now - self._mgr_addr_checked < 5.0:
+            return
+        try:
+            if self._mgr_addr is None or now - self._mgr_addr_checked > 5.0:
+                self._mgr_addr_checked = now
+                reply = self.monc.command({"prefix": "mgr stat"})
+                active = (
+                    json.loads(reply.outb).get("active")
+                    if reply.rc == 0
+                    else None
+                )
+                addr = active["addr"] if active else None
+                if addr != self._mgr_addr:
+                    self._mgr_addr = addr
+                    self._mgr_conn = None
+            if self._mgr_addr is None:
+                return
+            self.perf.set("numpg", len(self.pgs))
+            self.perf.set("recovery_active", self._recovery_active)
+            if self._mgr_conn is None or self._mgr_conn.is_closed:
+                host, _, port = self._mgr_addr.rpartition(":")
+                self._mgr_conn = self.messenger.connect(
+                    host, int(port), timeout=5.0
+                )
+            self._mgr_conn.send(
+                MMgrReport(
+                    daemon=f"osd.{self.whoami}",
+                    perf=json.dumps(self.perf.dump()),
+                )
+            )
+        except (MessageError, OSError, ValueError):
+            self._mgr_conn = None
+
+    def _on_worker(self, fn):
+        """Run ``fn`` on the op worker (PG mutations are serialized
+        there) and wait for the result — used by split side threads,
+        which must never touch PG state directly."""
+        import concurrent.futures as _f
+
+        fut: _f.Future = _f.Future()
+        self._workq.put(("splitcall", fn, fut))
+        return fut.result(30.0)
+
+    def _pg_num_grew(self, pg: PG) -> bool:
+        """True when the pool's pg_num grew past what this PG last
+        split against (persisted on PG_META; only a COMPLETED split
+        scan advances it, so failures and restarts rescan).  First
+        sight of a PG records the current pg_num — objects written
+        before that are wherever the client put them."""
+        pool = self._pool_of(pg)
+        if pool is None:
+            return False
+        try:
+            seen = int(
+                self.store.getattr(pg.cid, PG_META, "pg_num_seen")
+            )
+        except StoreError:
+            self._record_pg_num_seen(pg, pool.pg_num)
+            return False
+        return pool.pg_num > seen
+
+    def _record_pg_num_seen(self, pg: PG, value: int) -> None:
+        try:
+            txn = Transaction().touch(pg.cid, PG_META)
+            txn.setattr(
+                pg.cid, PG_META, "pg_num_seen", str(value).encode()
+            )
+            self.store.queue_transaction(txn)
+        except StoreError:
+            pass
+
+    def _split_scan(self, pg: PG, epoch: int) -> None:
+        """Re-home objects whose stable_mod slot moved to a child PG
+        after a pg_num increase (PG splitting, OSD::split_pgs role,
+        re-rendered as primary-driven logged migration): read the
+        object here, write it through the child primary's normal op
+        path, then logged-delete it locally — every step rides the
+        replicated machinery, so any acting-set topology works."""
+        from ..osdc.objecter import object_to_pg
+
+        pool = self._pool_of(pg)
+        if pool is None:
+            return
+        try:
+            oids = self.store.list_objects(pg.cid)
+        except StoreError:
+            return
+        failed = 0
+        for store_oid in oids:
+            if not store_oid.startswith(OBJ_PREFIX) or "@" in store_oid:
+                continue
+            oid = store_oid[len(OBJ_PREFIX):]
+            target = object_to_pg(pool, oid)
+            if target == pg.pgid:
+                continue
+            try:
+                self._migrate_object(pg, epoch, oid, store_oid, target)
+            except (StoreError, MessageError, OSError):
+                failed += 1  # keep going; a later pass rescans
+        if failed == 0:
+            # only a complete pass advances the split watermark
+            self._record_pg_num_seen(pg, pool.pg_num)
+
+    def _migrate_object(
+        self, pg: PG, epoch: int, oid: str, store_oid: str, target: str
+    ) -> None:
+        data = self.store.read(pg.cid, store_oid)
+        xattrs = {
+            k: v
+            for k, v in self.store.list_attrs(pg.cid, store_oid).items()
+            if k.startswith("u_")
+        }
+        omap = self.store.omap_get(pg.cid, store_oid)
+        ps = int(target.split(".")[1])
+        deadline = time.monotonic() + 15.0
+        ops = [(OSD_OP_WRITEFULL, data, "", b"")]
+        for name, val in sorted(xattrs.items()):
+            ops.append((OSD_OP_SETXATTR, val, name[2:], b""))
+        if omap:
+            e = Encoder()
+            e.map(
+                omap,
+                lambda e2, k: e2.string(k),
+                lambda e2, v: e2.bytes(v),
+            )
+            ops.append((OSD_OP_OMAPSET, e.getvalue(), "", b""))
+        for i, (op, payload, attr, _x) in enumerate(ops):
+            while True:
+                osdmap = self.monc.osdmap
+                _u, _up, _acting, primary = osdmap.pg_to_up_acting_osds(
+                    pg.pool_id, ps
+                )
+                msg = MOSDOp(
+                    pool=pg.pool_id, pgid=target, oid=oid, op=op,
+                    data=payload, length=-1, attr=attr,
+                    reqid=f"split.{pg.pgid}.{oid}.{i}",
+                    epoch=osdmap.epoch,
+                )
+                try:
+                    if primary == self.whoami:
+                        tpg = self.pgs.get(target)
+                        if tpg is not None and tpg.state == "active":
+                            self._on_worker(
+                                lambda tpg=tpg, msg=msg: self._mutate(
+                                    tpg, self.monc.epoch, msg,
+                                    OBJ_PREFIX + oid,
+                                )
+                            )
+                            break
+                        raise StoreError("child pg not active yet")
+                    conn = self._peer_conn(primary)
+                    reply = conn.call(msg, timeout=5.0)
+                    if getattr(reply, "ok", False):
+                        break
+                    raise StoreError(getattr(reply, "error", "nak"))
+                except (StoreError, MessageError, OSError):
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.2)
+        # logged local delete: replicas of the PARENT drop it too.
+        # Current epoch, not the enqueue-time one — a stale epoch
+        # would log a non-monotonic version that peering could judge
+        # divergent and roll back (resurrecting the object)
+        cur_epoch = self.monc.epoch
+        del_msg = MOSDOp(
+            pool=pg.pool_id, pgid=pg.pgid, oid=oid, op=OSD_OP_DELETE,
+            length=-1, reqid=f"split.{pg.pgid}.{oid}.del",
+            epoch=cur_epoch,
+        )
+        self._on_worker(
+            lambda: self._mutate(pg, cur_epoch, del_msg, store_oid)
+        )
 
     def _tick_loop(self) -> None:
         while not self._stop.wait(self.tick_interval):

@@ -110,3 +110,100 @@ def test_balancer_module_commits_upmaps(cluster):
             mgr.shutdown()
     finally:
         client.shutdown()
+
+
+def test_mgr_perf_plane_and_autoscaler():
+    """The daemon-stats plane (MMgrReport/DaemonServer role) + the
+    pg_autoscaler (VERDICT round-3 item 7): live OSDs push perf
+    reports the exporter turns into per-daemon series, and the
+    autoscaler doubles an undersized pool's pg_num — primaries split
+    (stable_mod re-homing), and every object stays readable through
+    librados afterwards."""
+    import json
+
+    from ceph_tpu.mgr import (
+        PgAutoscalerModule,
+        PrometheusModule,
+        StatusModule,
+    )
+
+    c = MiniCluster()
+    for i in range(3):
+        c.start_osd(i)
+    c.wait_active()
+    mgr = Manager(
+        modules=[PrometheusModule, StatusModule, PgAutoscalerModule]
+    )
+    try:
+        mgr.start(c.mon_addr)
+        r = Rados("perfplane").connect(*c.mon_addr)
+        r.pool_create("autoscale", pg_num=2, size=2)
+        io = r.open_ioctx("autoscale")
+        payload = {f"obj-{i}": bytes([i]) * (500 + i) for i in range(24)}
+        for oid, data in payload.items():
+            io.write_full(oid, data)
+        io.omap_set("obj-0", {"k0": b"v0"})
+
+        # -- perf reports arrive and surface as per-daemon series
+        assert wait_for(
+            lambda: len(mgr.get("daemon_perf") or {}) >= 3, 20.0
+        ), "OSDs never reported perf counters"
+        assert wait_for(
+            lambda: any(
+                d["op"] > 0
+                for d in mgr.get("daemon_perf").values()
+            ),
+            15.0,
+        )
+        perf = mgr.get("daemon_perf")
+        busy = max(perf, key=lambda d: perf[d]["op"])
+        assert perf[busy]["op"] > 0
+        assert perf[busy]["op_latency"]["avgcount"] > 0
+        port = mgr.modules["prometheus"].port
+        import urllib.request
+
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert f'ceph_daemon_op{{ceph_daemon="{busy}"}}' in body
+        assert (
+            f'ceph_daemon_op_latency_count{{ceph_daemon="{busy}"}}'
+            in body
+        )
+
+        # -- autoscaler recommends, then (mode=on) commits a doubling
+        scaler = mgr.modules["pg_autoscaler"]
+        mgr.set_module_option("pg_autoscaler", "target_pgs_per_osd", 8)
+        assert wait_for(
+            lambda: "autoscale" in scaler.recommendations, 15.0
+        ), "autoscaler never flagged the undersized pool"
+        rec = scaler.recommendations["autoscale"]
+        assert rec["ideal"] > rec["current"] == 2
+
+        mgr.set_module_option("pg_autoscaler", "mode", "on")
+        pool_id = r.pool_lookup("autoscale")
+
+        def pg_num_now():
+            return r.monc.osdmap.pools[pool_id].pg_num
+
+        assert wait_for(lambda: pg_num_now() >= 4, 30.0), (
+            "autoscaler never grew the pool"
+        )
+
+        # -- every object still readable through the normal
+        # hash-targeted client path after the split settles
+        def all_readable():
+            try:
+                return all(
+                    io.read(oid) == data
+                    for oid, data in payload.items()
+                )
+            except Exception:
+                return False
+
+        assert wait_for(all_readable, 40.0), "objects lost in split"
+        assert io.omap_get_vals("obj-0") == {"k0": b"v0"}
+        r.shutdown()
+    finally:
+        mgr.shutdown()
+        c.shutdown()
