@@ -776,6 +776,47 @@ def _cmd_pool_set(mon: Monitor, cmd: dict) -> MMonCommandReply:
     )
 
 
+def _cmd_sm_snap_create(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """Self-managed snap allocation (OSDMonitor / pg_pool_t
+    add_unmanaged_snap): the id is live for clone resolution and
+    trimming (recorded with an empty name), but only writers whose
+    snapc carries it clone — the pool's named-snap machinery stays
+    untouched."""
+    pid, pool = _pool_by_name(mon, cmd["pool"])
+    if pool is None:
+        return MMonCommandReply(rc=-2, outs=f"pool {cmd['pool']!r} not found")
+    import copy as _copy
+
+    newpool = _copy.deepcopy(pool)
+    newpool.snap_seq += 1
+    newpool.snaps[newpool.snap_seq] = ""
+    inc = mon.pending()
+    inc.new_pools[pid] = newpool
+    epoch = mon.commit(inc)
+    return MMonCommandReply(
+        outb=json.dumps({"snapid": newpool.snap_seq, "epoch": epoch})
+    )
+
+
+def _cmd_sm_snap_rm(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    pid, pool = _pool_by_name(mon, cmd["pool"])
+    if pool is None:
+        return MMonCommandReply(rc=-2, outs=f"pool {cmd['pool']!r} not found")
+    snapid = int(cmd["snapid"])
+    if snapid not in pool.snaps or pool.snaps[snapid] != "":
+        return MMonCommandReply(
+            rc=-2, outs=f"no self-managed snap {snapid} (-ENOENT)"
+        )
+    import copy as _copy
+
+    newpool = _copy.deepcopy(pool)
+    del newpool.snaps[snapid]
+    inc = mon.pending()
+    inc.new_pools[pid] = newpool
+    epoch = mon.commit(inc)
+    return MMonCommandReply(outb=json.dumps({"epoch": epoch}))
+
+
 _COMMANDS = {
     "status": _cmd_status,
     "osd down": _cmd_osd_down,
@@ -804,6 +845,8 @@ _COMMANDS = {
     "mgr beacon": _cmd_mgr_beacon,
     "mgr stat": _cmd_mgr_stat,
     "osd pool set": _cmd_pool_set,
+    "osd pool selfmanaged-snap create": _cmd_sm_snap_create,
+    "osd pool selfmanaged-snap rm": _cmd_sm_snap_rm,
 }
 
 

@@ -395,12 +395,16 @@ class MOSDOp(Message):
     reqid: str = ""  # stable across retries (osd_reqid_t role)
     epoch: int = 0  # client's map epoch (primary checks staleness)
     snapid: int = 0  # read snapshot (0 = head, CEPH_NOSNAP role)
+    # writer SnapContext seq (SnapContext::seq, PrimaryLogPG.h:632):
+    # self-managed snaps — make_writeable clones against THIS, not
+    # the pool's snap_seq, when the writer provides one
+    snap_seq: int = 0
 
     def encode_payload(self, e: Encoder) -> None:
         e.s64(self.pool).string(self.pgid).string(self.oid)
         e.u8(self.op).u64(self.offset).s64(self.length)
         e.bytes(self.data).string(self.attr).string(self.reqid)
-        e.u32(self.epoch).u64(self.snapid)
+        e.u32(self.epoch).u64(self.snapid).u64(self.snap_seq)
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MOSDOp":
@@ -408,7 +412,7 @@ class MOSDOp(Message):
             pool=d.s64(), pgid=d.string(), oid=d.string(),
             op=d.u8(), offset=d.u64(), length=d.s64(),
             data=d.bytes(), attr=d.string(), reqid=d.string(),
-            epoch=d.u32(), snapid=d.u64(),
+            epoch=d.u32(), snapid=d.u64(), snap_seq=d.u64(),
         )
 
 

@@ -1009,33 +1009,25 @@ class OSD(Dispatcher):
         except (StoreError, ValueError):
             return 0
 
-    def _maybe_clone(
-        self, pg: PG, epoch: int, oid: str, existed: bool
+    def _commit_internal(
+        self,
+        pg: PG,
+        epoch: int,
+        oid: str,
+        txn: Transaction,
+        op=None,
+        prior_version=(1, 0),
     ) -> None:
-        """Clone-on-first-write-after-snap (PrimaryLogPG::
-        make_writeable): before a mutation lands on an object that
-        predates the pool's newest snap, preserve the head as
-        "<oid>@<snap_seq>" — ONE store-local clone op riding a logged
-        transaction of its own, so clones replicate, recover, and
-        reconstruct exactly like any object on both backends."""
-        pool = self._pool_of(pg)
-        snapc = pool.snap_seq if pool is not None else 0
-        if not existed or snapc <= 0:
-            return
-        head = OBJ_PREFIX + oid
-        clone_store = OBJ_PREFIX + f"{oid}@{snapc}"
-        if self.store.exists(pg.cid, clone_store):
-            return  # already preserved for this snap context
-        if self._born_at(pg, head) >= snapc:
-            return  # object born after the newest snap: nothing owed
-        txn = Transaction().clone(pg.cid, head, clone_store)
+        """One internally-generated mutation through the SAME logged
+        replication path client ops ride (clone preservation, snap
+        trims, watch records)."""
         pg.seq += 1
         entry = LogEntry(
-            op=MODIFY,
-            oid=f"{oid}@{snapc}",
+            op=MODIFY if op is None else op,
+            oid=oid,
             version=(epoch, pg.seq),
             reqid="",
-            prior_version=EV_ZERO,
+            prior_version=prior_version,
         )
         targets = {
             osd: txn
@@ -1046,6 +1038,47 @@ class OSD(Dispatcher):
         self._commit_and_replicate(
             pg, epoch, types.SimpleNamespace(reqid=""), entry,
             targets, b"",
+        )
+
+    def _maybe_clone(
+        self, pg: PG, epoch: int, oid: str, existed: bool,
+        writer_seq: int = 0,
+    ) -> None:
+        """Clone-on-first-write-after-snap (PrimaryLogPG::
+        make_writeable): before a mutation lands on an object that
+        predates the pool's newest snap, preserve the head as
+        "<oid>@<snap_seq>" — ONE store-local clone op riding a logged
+        transaction of its own, so clones replicate, recover, and
+        reconstruct exactly like any object on both backends."""
+        pool = self._pool_of(pg)
+        named = (
+            max(
+                (s for s, name in pool.snaps.items() if name),
+                default=0,
+            )
+            if pool is not None
+            else 0
+        )
+        # per-op writer SnapContext (make_writeable,
+        # PrimaryLogPG.cc:1209): a writer's self-managed seq drives
+        # its clones, so two images in one pool snapshot
+        # independently; a NAMED pool snap newer than the writer's
+        # context still wins (a stale writer must not overwrite a
+        # snapshot the admin just took), and bystanders without a
+        # context follow named snaps only
+        snapc = max(writer_seq, named)
+        if not existed or snapc <= 0:
+            return
+        head = OBJ_PREFIX + oid
+        clone_store = OBJ_PREFIX + f"{oid}@{snapc}"
+        if self.store.exists(pg.cid, clone_store):
+            return  # already preserved for this snap context
+        if self._born_at(pg, head) >= snapc:
+            return  # object born after the newest snap: nothing owed
+        txn = Transaction().clone(pg.cid, head, clone_store)
+        self._commit_internal(
+            pg, epoch, f"{oid}@{snapc}", txn,
+            prior_version=EV_ZERO,
         )
 
     def _resolve_snap_read(self, pg: PG, oid: str, snapid: int) -> str:
@@ -1114,28 +1147,9 @@ class OSD(Dispatcher):
                     .touch(pg.cid, clone_store)
                     .remove(pg.cid, clone_store)
                 )
-                pg.seq += 1
-                entry = LogEntry(
-                    op=DELETE,
-                    oid=f"{base}@{c}",
-                    version=(epoch, pg.seq),
-                    reqid="",
-                    prior_version=(1, 0),
-                )
-                targets = {
-                    osd: txn
-                    for osd in pg.acting
-                    if osd != CRUSH_ITEM_NONE
-                    and (
-                        osd == self.whoami
-                        or self.monc.osdmap.is_up(osd)
-                    )
-                }
                 try:
-                    self._commit_and_replicate(
-                        pg, epoch,
-                        types.SimpleNamespace(reqid=""), entry,
-                        targets, b"",
+                    self._commit_internal(
+                        pg, epoch, f"{base}@{c}", txn, op=DELETE
                     )
                 except StoreError:
                     return
@@ -1144,8 +1158,11 @@ class OSD(Dispatcher):
                     return
 
     # -- watch/notify (PrimaryLogPG watchers / Notify) ---------------------
+    WATCH_ATTR = "w_"
+
     def _handle_watch(self, pg: PG, conn: Connection, msg: MOSDOp):
         key = (pg.pgid, msg.oid)
+        store_oid = OBJ_PREFIX + msg.oid
         with self._watch_lock:
             if msg.op == OSD_OP_WATCH:
                 self._watchers.setdefault(key, {})[msg.offset] = conn
@@ -1154,39 +1171,108 @@ class OSD(Dispatcher):
                 watchers.pop(msg.offset, None)
                 if not watchers:
                     self._watchers.pop(key, None)
+        # persist the watch record in object metadata (watch_info in
+        # object_info_t, src/osd/osd_types.h) through the SAME logged
+        # path as any mutation, so the record survives primary
+        # failover and the NEW primary holds notifies for this
+        # watcher until its linger re-attaches
+        attr = self.WATCH_ATTR + str(msg.offset)
+        try:
+            have = attr in self.store.list_attrs(pg.cid, store_oid)
+        except StoreError:
+            # watch on a nonexistent object: reject like the
+            # reference (-ENOENT) — a memory-only watch would lose
+            # exactly the failover guarantee the record provides
+            if msg.op == OSD_OP_WATCH:
+                with self._watch_lock:
+                    ws = self._watchers.get(key, {})
+                    ws.pop(msg.offset, None)
+                    if not ws:
+                        self._watchers.pop(key, None)
+                raise StoreError(
+                    f"no object {msg.oid} to watch (-ENOENT)"
+                )
+            return
+        epoch = self.monc.epoch
+        if msg.op == OSD_OP_WATCH and not have:
+            txn = Transaction().touch(pg.cid, store_oid)
+            txn.setattr(pg.cid, store_oid, attr, b"1")
+        elif msg.op == OSD_OP_UNWATCH and have:
+            txn = Transaction().touch(pg.cid, store_oid)
+            txn.rmattr(pg.cid, store_oid, attr)
+        else:
+            return  # re-register / already gone: record is current
+        try:
+            self._commit_internal(pg, epoch, msg.oid, txn)
+        except StoreError:
+            pass  # record update retries on the client's next linger
+
+    def _persisted_watchers(self, pg: PG, oid: str) -> set[int]:
+        try:
+            return {
+                int(a[len(self.WATCH_ATTR):])
+                for a in self.store.list_attrs(
+                    pg.cid, OBJ_PREFIX + oid
+                )
+                if a.startswith(self.WATCH_ATTR)
+            }
+        except (StoreError, ValueError):
+            return set()
 
     def _notify_watchers(
         self, pg: PG, oid: str, payload: bytes, timeout: float = 2.0
     ) -> list[dict]:
         """Fan a notify to every watcher and gather acks (Notify's
-        completion gathering with a timeout for dead watchers)."""
+        completion gathering with a timeout for dead watchers).
+
+        The watcher set is the union of live connections and the
+        PERSISTED records in object metadata: after a primary
+        failover the new primary has records but no connections yet —
+        a notify posted in that window waits for the watchers'
+        lingers to re-attach (instead of being silently lost) and
+        delivers within the timeout."""
         key = (pg.pgid, oid)
+        want = set(self._persisted_watchers(pg, oid))
         with self._watch_lock:
-            watchers = dict(self._watchers.get(key, {}))
-        if not watchers:
+            want |= set(self._watchers.get(key, {}))
+        if not want:
             return []
         notify_id = next(self._notify_seq)
         state = {
-            "want": set(watchers),
+            "want": set(want),
             "acks": {},
             "event": threading.Event(),
         }
         self._notify_pending[notify_id] = state
-        for cookie, conn in watchers.items():
-            try:
-                conn.send(
-                    MWatchNotify(
-                        tid=self.messenger.new_tid(),
-                        oid=oid, notify_id=notify_id,
-                        cookie=cookie, payload=payload,
+        sent: set[int] = set()
+        deadline = time.monotonic() + max(timeout, 0.0)
+        while True:
+            with self._watch_lock:
+                connected = dict(self._watchers.get(key, {}))
+            for cookie in state["want"] - sent:
+                conn = connected.get(cookie)
+                if conn is None:
+                    continue  # awaiting the linger re-attach
+                sent.add(cookie)
+                try:
+                    conn.send(
+                        MWatchNotify(
+                            tid=self.messenger.new_tid(),
+                            oid=oid, notify_id=notify_id,
+                            cookie=cookie, payload=payload,
+                        )
                     )
-                )
-            except (MessageError, OSError):
-                state["want"].discard(cookie)
-                with self._watch_lock:
-                    self._watchers.get(key, {}).pop(cookie, None)
-        if state["want"] and timeout > 0:
-            state["event"].wait(timeout)
+                except (MessageError, OSError):
+                    # re-send when the linger re-attaches this cookie
+                    sent.discard(cookie)
+                    with self._watch_lock:
+                        self._watchers.get(key, {}).pop(cookie, None)
+            if set(state["acks"]) >= state["want"]:
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            state["event"].wait(min(remaining, 0.1))
         self._notify_pending.pop(notify_id, None)
         return [
             {
@@ -1196,7 +1282,7 @@ class OSD(Dispatcher):
                     "latin-1"
                 ),
             }
-            for cookie in watchers
+            for cookie in sorted(state["want"])
         ]
 
     def _handle_notify_ack(self, msg: MWatchNotifyAck) -> None:
@@ -1255,7 +1341,9 @@ class OSD(Dispatcher):
             raise StoreError(f"no object {msg.oid} (-ENOENT)")
         # snap context: preserve the pre-mutation head if the pool has
         # a snap this object has not been cloned for (make_writeable)
-        self._maybe_clone(pg, epoch, msg.oid, existed)
+        self._maybe_clone(
+            pg, epoch, msg.oid, existed, msg.snap_seq
+        )
         ctx = None
         outdata = b""
         if msg.op == OSD_OP_CALL:
@@ -1496,7 +1584,9 @@ class OSD(Dispatcher):
         # snap context (make_writeable): the clone op copies each
         # position's LOCAL shard, so one logged txn preserves the
         # erasure-coded head too
-        self._maybe_clone(pg, epoch, msg.oid, existed)
+        self._maybe_clone(
+            pg, epoch, msg.oid, existed, msg.snap_seq
+        )
         ctx = None
         outdata = b""
         if msg.op == OSD_OP_CALL:

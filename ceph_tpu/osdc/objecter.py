@@ -117,6 +117,7 @@ class Objecter:
         attr: str = "",
         pgid: str | None = None,
         snapid: int = 0,
+        snap_seq: int = 0,
     ) -> MOSDOpReply:
         """Target, send, and retry until acked or timed out."""
         deadline = time.monotonic() + self.op_timeout
@@ -136,7 +137,7 @@ class Objecter:
                         pool=pool_id, pgid=tgt_pgid, oid=oid, op=op,
                         offset=offset, length=length, data=data,
                         attr=attr, reqid=reqid, epoch=self.monc.epoch,
-                        snapid=snapid,
+                        snapid=snapid, snap_seq=snap_seq,
                     ),
                     timeout=min(5.0, self.op_timeout),
                 )

@@ -173,17 +173,21 @@ class IoCtx:
         self.pool_id = pool_id
         # read snapshot context (rados_ioctx_snap_set_read): 0 = head
         self.read_snap = 0
+        # writer SnapContext seq (rados_ioctx_selfmanaged_snap_
+        # set_write_ctx): 0 = follow the pool's snaps
+        self.write_snap_seq = 0
 
     # -- sync data ops -----------------------------------------------------
     def write_full(self, oid: str, data: bytes) -> None:
         self.rados.objecter.op_submit(
-            self.pool_id, oid, OSD_OP_WRITEFULL, data=bytes(data)
+            self.pool_id, oid, OSD_OP_WRITEFULL, data=bytes(data),
+            snap_seq=self.write_snap_seq,
         )
 
     def write(self, oid: str, data: bytes, offset: int = 0) -> None:
         self.rados.objecter.op_submit(
             self.pool_id, oid, OSD_OP_WRITE, offset=offset,
-            data=bytes(data),
+            data=bytes(data), snap_seq=self.write_snap_seq,
         )
 
     def append(self, oid: str, data: bytes) -> None:
@@ -191,7 +195,8 @@ class IoCtx:
         the PG op stream (a client-side stat+write would race
         concurrent appenders)."""
         self.rados.objecter.op_submit(
-            self.pool_id, oid, OSD_OP_APPEND, data=bytes(data)
+            self.pool_id, oid, OSD_OP_APPEND, data=bytes(data),
+            snap_seq=self.write_snap_seq,
         )
 
     def read(self, oid: str, length: int = -1, offset: int = 0) -> bytes:
@@ -241,6 +246,45 @@ class IoCtx:
     def snap_list(self) -> dict[int, str]:
         return dict(self._pool().snaps)
 
+    # -- self-managed snaps (rados_ioctx_selfmanaged_snap_*) ---------------
+    def set_snap_context(self, seq: int) -> None:
+        """Writer SnapContext for subsequent mutations: the primary's
+        make_writeable clones against THIS seq instead of the pool's
+        (per-op writer snapc, PrimaryLogPG.h:632)."""
+        self.write_snap_seq = int(seq)
+
+    def selfmanaged_snap_create(self) -> int:
+        """Allocate a snap id the CLIENT manages (librbd's snapshot
+        pattern): the pool tracks it as live for clone resolution and
+        trimming, but only writers carrying it in their snapc clone."""
+        pool_name = self.rados.monc.osdmap.pool_names[self.pool_id]
+        reply = self.rados.monc.command(
+            {
+                "prefix": "osd pool selfmanaged-snap create",
+                "pool": pool_name,
+            }
+        )
+        if reply.rc != 0:
+            raise RadosError(reply.outs)
+        out = json.loads(reply.outb)
+        self.rados.monc.wait_for_epoch(out["epoch"])
+        return out["snapid"]
+
+    def selfmanaged_snap_remove(self, snapid: int) -> None:
+        pool_name = self.rados.monc.osdmap.pool_names[self.pool_id]
+        reply = self.rados.monc.command(
+            {
+                "prefix": "osd pool selfmanaged-snap rm",
+                "pool": pool_name,
+                "snapid": int(snapid),
+            }
+        )
+        if reply.rc != 0:
+            raise RadosError(reply.outs)
+        self.rados.monc.wait_for_epoch(
+            json.loads(reply.outb)["epoch"]
+        )
+
     def snap_lookup(self, name: str) -> int:
         for sid, sname in self._pool().snaps.items():
             if sname == name:
@@ -258,7 +302,13 @@ class IoCtx:
         """Register ``callback(payload) -> reply_bytes|None`` and
         return the watch handle (cookie).  The watch lingers: it is
         re-registered on every map change."""
-        cookie = next(self.rados._watch_seq)
+        # cookies must be cluster-unique (the reference keys
+        # watch_info by (entity, cookie)): fold the objecter's
+        # client id in so two clients' first watches cannot collide
+        # on the same persisted record
+        cookie = (
+            (int(self.rados.objecter._client_id, 16) & 0x3FFFFF) << 20
+        ) | next(self.rados._watch_seq)
         self.rados._watch_cbs[cookie] = callback
         self.rados.objecter.op_submit(
             self.pool_id, oid, OSD_OP_WATCH, offset=cookie

@@ -229,3 +229,91 @@ def test_snapshots_on_erasure_pool(cluster, client):
     io.snap_set_read("es1")
     assert io.read("eobj") == data1
     io.snap_set_read(0)
+
+
+def test_notify_survives_primary_failover(cluster, client):
+    """VERDICT round-3 item 8 (watch half): watch records persist in
+    object metadata through the logged path, so after the primary
+    dies a notify posted to the NEW primary waits for the watcher's
+    linger to re-attach and is DELIVERED — not silently lost."""
+    a = Rados("watch-a").connect(*cluster.mon_addr)
+    b = Rados("watch-b").connect(*cluster.mon_addr)
+    try:
+        ioa = a.open_ioctx("snappool")
+        iob = b.open_ioctx("snappool")
+        ioa.write_full("failover-watched", b"v1")
+        got = []
+        ioa.watch(
+            "failover-watched",
+            lambda payload: got.append(payload) or b"seen",
+        )
+        assert iob.notify("failover-watched", b"warm")  # plane works
+        assert got == [b"warm"]
+
+        # kill the primary; its replacement has the persisted record
+        # but no connection until A's linger re-attaches
+        from ceph_tpu.osdc.objecter import object_to_pg
+
+        pool = a.monc.osdmap.pools[a.pool_lookup("snappool")]
+        pgid = object_to_pg(pool, "failover-watched")
+        ps = int(pgid.split(".")[1])
+        *_rest, primary = a.monc.osdmap.pg_to_up_acting_osds(
+            pool.pool_id, ps
+        )
+        cluster.kill_osd(primary)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if not b.monc.osdmap.is_up(primary):
+                break
+            time.sleep(0.1)
+        assert not b.monc.osdmap.is_up(primary)
+
+        acks = iob.notify("failover-watched", b"post-failover")
+        assert any(x["acked"] for x in acks), acks
+        assert got[-1] == b"post-failover"
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_selfmanaged_snap_context(cluster, client):
+    """VERDICT round-3 item 8 (snap half): per-op writer SnapContext
+    — a writer carrying its own snapc clones against IT, so two
+    'images' in one pool snapshot independently (the librbd
+    pattern)."""
+    r = Rados("smsnap").connect(*cluster.mon_addr)
+    try:
+        io = r.open_ioctx("snappool")
+        io.write_full("imgA", b"A-v1")
+        io.write_full("imgB", b"B-v1")
+
+        sid = io.selfmanaged_snap_create()
+        # writer for image A adopts the snapc; image B's writer stays
+        # on its old (empty) context
+        io.set_snap_context(sid)
+        io.write_full("imgA", b"A-v2")
+        io.set_snap_context(0)
+        io.write_full("imgB", b"B-v2")
+
+        io.read_snap = sid
+        assert io.read("imgA") == b"A-v1"  # cloned under A's snapc
+        # B's writer carried no snapc: head overwritten in place
+        assert io.read("imgB") == b"B-v2"
+        io.read_snap = 0
+        assert io.read("imgA") == b"A-v2"
+
+        # a second self-managed snap stacks
+        sid2 = io.selfmanaged_snap_create()
+        io.set_snap_context(sid2)
+        io.write_full("imgA", b"A-v3")
+        io.read_snap = sid2
+        assert io.read("imgA") == b"A-v2"
+        io.read_snap = sid
+        assert io.read("imgA") == b"A-v1"
+        io.read_snap = 0
+
+        # removal frees the id; the clone trims on the snap tick
+        io.selfmanaged_snap_remove(sid)
+        assert sid not in io.snap_list()
+    finally:
+        r.shutdown()
