@@ -54,7 +54,6 @@ from __future__ import annotations
 import concurrent.futures
 import itertools
 import json
-import queue
 import threading
 import time
 import types
@@ -83,6 +82,13 @@ from ..msg import (
     MPing,
 )
 from ..common.perf_counters import PerfCountersBuilder
+from ..common.throttle import Throttle
+from .scheduler import (
+    CLASS_BACKGROUND,
+    CLASS_CLIENT,
+    CLASS_RECOVERY,
+    WeightedPriorityQueue,
+)
 from ..msg.message import (
     MMgrReport,
     OSD_OP_APPEND,
@@ -215,6 +221,7 @@ class OSD(Dispatcher):
         heartbeat_grace: float = 2.0,
         scrub_interval: float = 0.0,
         recovery_max_active: int = 3,
+        client_message_cap: int = 256 << 20,
     ):
         """``scrub_interval`` > 0 arms tick-driven scrub scheduling
         (osd_scrub_min_interval); ``recovery_max_active`` caps
@@ -228,7 +235,17 @@ class OSD(Dispatcher):
         )
         self.pgs: dict[str, PG] = {}
         self._pg_lock = threading.RLock()
-        self._workq: queue.Queue = queue.Queue()
+        # the op worker drains a QoS-classed scheduler, not a FIFO:
+        # peering/map events are strict, client ops and background
+        # work (scrub, splits) share by weight (OpScheduler role)
+        self._workq = WeightedPriorityQueue()
+        # client-message admission control (osd_client_message_size_
+        # cap role): over-budget ops are bounced with -EAGAIN (the
+        # objecter retries), so one firehose client cannot queue the
+        # daemon into the ground
+        self.client_throttle = Throttle(
+            f"osd.{whoami}.client-bytes", client_message_cap
+        )
         self._worker: threading.Thread | None = None
         self._ticker: threading.Thread | None = None
         self._stop = threading.Event()
@@ -430,7 +447,10 @@ class OSD(Dispatcher):
                     ):
                         # pg_num grew: re-home objects whose
                         # stable_mod slot moved (PG splitting)
-                        self._workq.put(("split", pg.pgid, epoch))
+                        self._workq.enqueue(
+                            CLASS_BACKGROUND, 1,
+                            ("split", pg.pgid, epoch),
+                        )
                 else:
                     if changed:
                         # new interval: wait for the primary's
@@ -1957,8 +1977,23 @@ class OSD(Dispatcher):
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
         if isinstance(msg, MOSDOp):
-            # nested RPC needed → worker queue (enqueue_op)
-            self._workq.put(("op", conn, msg))
+            # nested RPC needed → worker queue (enqueue_op), as a
+            # weighted CLIENT-class item costed by payload size;
+            # admission-controlled by the client throttle
+            cost = len(msg.data) + 1024
+            if not self.client_throttle.get_or_fail(cost):
+                reply = MOSDOpReply(
+                    tid=msg.tid, ok=False,
+                    error="client throttle full (-EAGAIN)",
+                )
+                try:
+                    conn.send(reply)
+                except (MessageError, OSError):
+                    pass
+                return True
+            self._workq.enqueue(
+                CLASS_CLIENT, cost, ("op", conn, msg, cost)
+            )
             return True
         if isinstance(msg, MOSDRepOp):
             self._handle_rep_op(conn, msg)
@@ -1972,7 +2007,12 @@ class OSD(Dispatcher):
         if isinstance(msg, MPGPull):
             if msg.shard >= 0:
                 # erasure reconstruct = nested sub-op RPC → worker
-                self._workq.put(("pull", conn, msg))
+                # recovery traffic shares by weight; strict-queueing
+                # it would starve queued client ops behind a
+                # sustained pull stream
+                self._workq.enqueue(
+                    CLASS_RECOVERY, 4096, ("pull", conn, msg)
+                )
             else:
                 self._handle_pull(conn, msg)
             return True
@@ -2125,7 +2165,10 @@ class OSD(Dispatcher):
                 if kind == "map":
                     self._walk_pgs(item[1])
                 elif kind == "op":
-                    self._handle_op(item[1], item[2])
+                    try:
+                        self._handle_op(item[1], item[2])
+                    finally:
+                        self.client_throttle.put(item[3])
                 elif kind == "activate":
                     self._apply_activate(item[1], item[2])
                 elif kind == "pull":
@@ -2404,7 +2447,9 @@ class OSD(Dispatcher):
                     ]
                 for pgid in due:
                     self._scrubbing.add(pgid)
-                    self._workq.put(("scrub", pgid))
+                    self._workq.enqueue(
+                        CLASS_BACKGROUND, 1, ("scrub", pgid)
+                    )
             # mon session failover (MonClient reconnect)
             try:
                 self.monc.ensure_connected()

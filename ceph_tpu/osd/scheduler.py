@@ -1,0 +1,141 @@
+"""Op scheduler — the OSD worker queue with QoS classes
+(src/osd/scheduler/OpScheduler.cc + WeightedPriorityQueue.h reduced).
+
+The reference feeds every shard worker from an OpScheduler: strict
+items (peering/map events) preempt everything, and the remaining
+classes (client ops, recovery, scrub/background) share the worker in
+proportion to configured weights via a weighted round-robin over op
+COST — so a burst of background work cannot starve client ops, and
+vice versa.  Same machinery here, replacing the plain FIFO the
+daemon's worker drained before:
+
+- ``enqueue(klass, cost, item)`` / ``dequeue()`` — the OpScheduler
+  surface; CLASS_STRICT dequeues first, always in FIFO order.
+- weighted classes drain by deficit round-robin: each visit grants a
+  class ``weight`` credits; items charge their cost against them —
+  byte-sized client ops and chunky recovery pushes share accurately.
+- ``put``/``get`` aliases keep the queue.Queue shape the daemon's
+  producers already use (None = shutdown sentinel, delivered ahead
+  of everything).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+CLASS_STRICT = "strict"  # peering/map/activation: never queued behind IO
+CLASS_CLIENT = "client"
+CLASS_RECOVERY = "recovery"
+CLASS_BACKGROUND = "background"  # scrub, splits, trims
+
+DEFAULT_WEIGHTS = {
+    # osd_op_queue weights role: client IO dominates, recovery gets a
+    # protected share, background trickles
+    CLASS_CLIENT: 63,
+    CLASS_RECOVERY: 10,
+    CLASS_BACKGROUND: 5,
+}
+
+
+class WeightedPriorityQueue:
+    """Strict + deficit-weighted-round-robin work queue."""
+
+    def __init__(self, weights: dict[str, int] | None = None):
+        self._draining = False
+        self.weights = dict(weights or DEFAULT_WEIGHTS)
+        self._strict: collections.deque = collections.deque()
+        self._queues: dict[str, collections.deque] = {
+            k: collections.deque() for k in self.weights
+        }
+        self._credit: dict[str, float] = {k: 0.0 for k in self.weights}
+        self._rr = list(self.weights)  # round-robin order
+        self._rr_pos = 0
+        self._fresh = True  # current class not yet granted this visit
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._size = 0
+
+    # -- OpScheduler surface ----------------------------------------------
+    def enqueue(self, klass: str, cost: int, item) -> None:
+        with self._cond:
+            if klass == CLASS_STRICT or klass not in self._queues:
+                self._strict.append(item)
+            else:
+                self._queues[klass].append((max(int(cost), 1), item))
+            self._size += 1
+            self._cond.notify()
+
+    def dequeue(self, timeout: float | None = None):
+        with self._cond:
+            while self._size == 0:
+                if self._draining:
+                    return None  # shutdown AFTER the queue drained
+                if not self._cond.wait(timeout):
+                    raise TimeoutError("queue idle")
+            self._size -= 1
+            if self._strict:
+                return self._strict.popleft()
+            # deficit round-robin: the current class serves while its
+            # credit lasts (a burst proportional to its weight), gets
+            # ONE quantum grant per visit, then yields the worker —
+            # an expensive head accumulates credit across laps
+            # instead of being skipped forever
+            n = len(self._rr)
+            spins = 0
+            while spins <= 2 * n:
+                klass = self._rr[self._rr_pos]
+                q = self._queues[klass]
+                if not q:
+                    self._credit[klass] = 0.0
+                    self._rr_pos = (self._rr_pos + 1) % n
+                    self._fresh = True
+                    spins += 1
+                    continue
+                if self._fresh:
+                    # the quantum grants on ARRIVAL at a class, once
+                    # per visit — granting whenever credit ran short
+                    # would let one class hold the worker forever
+                    self._credit[klass] += self.weights[klass]
+                    self._fresh = False
+                cost, item = q[0]
+                if cost <= self._credit[klass]:
+                    q.popleft()
+                    self._credit[klass] -= cost
+                    if not q:
+                        self._credit[klass] = 0.0
+                    return item
+                self._rr_pos = (self._rr_pos + 1) % n
+                self._fresh = True
+                spins += 1
+            # every head exceeded a full lap of grants: serve the
+            # cheapest head rather than stalling
+            best = min(
+                (q[0][0], k)
+                for k, q in self._queues.items()
+                if q
+            )
+            cost, item = self._queues[best[1]].popleft()
+            self._credit[best[1]] = 0.0
+            return item
+
+    def qlen(self) -> int:
+        with self._lock:
+            return self._size
+
+    # -- queue.Queue-shaped aliases (the daemon's producer surface) --------
+    def put(self, item) -> None:
+        """Untyped put: legacy tuples go strict; None marks the queue
+        DRAINING — the consumer sees it only once everything already
+        queued has been served (queue.Queue's FIFO sentinel
+        semantics, which the daemon's shutdown relies on: queued ops
+        still get replies and release their throttle budget)."""
+        if item is None:
+            with self._cond:
+                self._draining = True
+                self._cond.notify_all()
+            return
+        self.enqueue(CLASS_STRICT, 0, item)
+
+    def get(self, timeout: float | None = None):
+        return self.dequeue(timeout)

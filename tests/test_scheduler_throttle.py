@@ -1,0 +1,173 @@
+"""Op scheduler (WPQ) + Throttle — QoS and admission control
+(src/osd/scheduler/OpScheduler.cc, src/common/Throttle.cc; VERDICT
+round-3 'What's missing' item 8)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.common.throttle import Throttle
+from ceph_tpu.osd.scheduler import (
+    CLASS_BACKGROUND,
+    CLASS_CLIENT,
+    CLASS_RECOVERY,
+    CLASS_STRICT,
+    WeightedPriorityQueue,
+)
+
+
+def test_strict_preempts_everything_and_sentinel_drains():
+    q = WeightedPriorityQueue()
+    for i in range(5):
+        q.enqueue(CLASS_CLIENT, 1, f"c{i}")
+    q.enqueue(CLASS_STRICT, 0, "peering")
+    q.put(None)  # shutdown sentinel: delivered only after draining
+    assert q.dequeue() == "peering"
+    drained = [q.dequeue() for _ in range(5)]
+    assert drained == [f"c{i}" for i in range(5)]
+    assert q.dequeue() is None
+    assert q.dequeue() is None  # stays drained
+
+
+def test_weighted_shares_track_weights():
+    q = WeightedPriorityQueue(
+        weights={CLASS_CLIENT: 60, CLASS_RECOVERY: 30, CLASS_BACKGROUND: 10}
+    )
+    for i in range(300):
+        q.enqueue(CLASS_CLIENT, 1, ("client", i))
+        q.enqueue(CLASS_RECOVERY, 1, ("recovery", i))
+        q.enqueue(CLASS_BACKGROUND, 1, ("background", i))
+    first = [q.dequeue()[0] for _ in range(200)]
+    counts = {k: first.count(k) for k in ("client", "recovery", "background")}
+    # proportional within a generous tolerance: client ~60%, recovery
+    # ~30%, background ~10%
+    assert counts["client"] > counts["recovery"] > counts["background"]
+    assert counts["client"] >= 100
+    assert counts["background"] >= 5
+
+
+def test_costed_items_charge_their_cost():
+    q = WeightedPriorityQueue(
+        weights={CLASS_CLIENT: 10, CLASS_RECOVERY: 10, CLASS_BACKGROUND: 1}
+    )
+    # recovery pushes are 10x the cost of client ops: equal weights
+    # must yield ~10x as many client dequeues
+    for i in range(200):
+        q.enqueue(CLASS_CLIENT, 1, ("client", i))
+    for i in range(200):
+        q.enqueue(CLASS_RECOVERY, 10, ("recovery", i))
+    first = [q.dequeue()[0] for _ in range(110)]
+    c = first.count("client")
+    r = first.count("recovery")
+    assert c > 5 * r, (c, r)
+
+
+def test_empty_class_never_stalls_and_big_op_drains():
+    q = WeightedPriorityQueue(
+        weights={CLASS_CLIENT: 2, CLASS_RECOVERY: 2, CLASS_BACKGROUND: 2}
+    )
+    # one enormous op with tiny weights: credit accumulates across
+    # laps (or the cheapest-head escape fires) — never a stall
+    q.enqueue(CLASS_CLIENT, 1000, "huge")
+    assert q.dequeue(timeout=2.0) == "huge"
+    with pytest.raises(TimeoutError):
+        q.dequeue(timeout=0.05)
+
+
+def test_throttle_blocks_fifo_and_get_or_fail():
+    t = Throttle("t", 10)
+    assert t.get_or_fail(8)
+    assert not t.get_or_fail(4)
+    order = []
+
+    def taker(tag, amount):
+        assert t.get(amount, timeout=5.0)
+        order.append(tag)
+
+    a = threading.Thread(target=taker, args=("first", 6))
+    a.start()
+    time.sleep(0.05)
+    b = threading.Thread(target=taker, args=("second", 1))
+    b.start()
+    time.sleep(0.05)
+    # a small later request must NOT barge past the parked large one
+    assert order == []
+    t.put(8)  # 0 in flight: first (6) fits, then second (1)
+    a.join(2)
+    b.join(2)
+    assert order == ["first", "second"]
+    assert t.current == 7
+    # timeout path returns the budget untaken
+    assert not t.get(100, timeout=0.05)
+    t.put(7)
+    assert t.get_or_fail(10)
+
+
+def test_oversized_request_admitted_alone():
+    t = Throttle("t", 4)
+    assert t.get_or_fail(2)
+    got = []
+    th = threading.Thread(
+        target=lambda: got.append(t.get(100, timeout=5.0))
+    )
+    th.start()
+    time.sleep(0.05)
+    assert got == []  # waits for the throttle to drain
+    t.put(2)
+    th.join(2)
+    assert got == [True]
+
+
+def test_osd_client_throttle_bounces_and_client_retries():
+    """Integration: a tiny client cap bounces bursts with -EAGAIN and
+    the objecter's retry machinery rides through — writes all land."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_osd_daemon import MiniCluster
+    from ceph_tpu.osd.daemon import OSD
+    from ceph_tpu.rados import Rados
+
+    c = MiniCluster.__new__(MiniCluster)
+    from ceph_tpu.mon.monitor import Monitor, MonClient
+    from ceph_tpu.msg import Messenger
+    import test_osd_daemon as tod
+
+    c.mon = Monitor(tod._base_map(), min_reporters=2)
+    c.mon_msgr = Messenger("mon")
+    c.mon_msgr.add_dispatcher(c.mon)
+    c.mon_addr = c.mon_msgr.bind()
+    c.osds = {}
+    c.client_msgr = Messenger("client")
+    c.monc = MonClient(c.client_msgr, whoami=-1)
+    c.monc.connect(*c.mon_addr)
+    for i in range(3):
+        osd = OSD(
+            i, tick_interval=0.2, heartbeat_grace=1.0,
+            client_message_cap=8192,  # a few KB: bursts WILL bounce
+        )
+        osd.boot(*c.mon_addr)
+        c.osds[i] = osd
+    c.wait_active()
+    try:
+        r = Rados("throttled").connect(*c.mon_addr)
+        r.pool_create("tp", pg_num=2, size=2)
+        io = r.open_ioctx("tp")
+        import concurrent.futures
+
+        payload = {f"o{i}": bytes([i]) * 3000 for i in range(24)}
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            list(
+                ex.map(
+                    lambda kv: io.write_full(kv[0], kv[1]),
+                    payload.items(),
+                )
+            )
+        for oid, data in payload.items():
+            assert io.read(oid) == data
+        r.shutdown()
+    finally:
+        c.shutdown()
