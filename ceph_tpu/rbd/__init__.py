@@ -111,9 +111,16 @@ class Image:
     """One open image (librbd::Image): striped read/write/discard,
     resize, snapshot-routed reads."""
 
-    def __init__(self, ioctx, name: str):
+    def __init__(self, ioctx, name: str, cache: bool = False,
+                 cache_opts: dict | None = None):
+        """``cache=True`` opens the image behind an ObjectCacher
+        (rbd_cache role): reads serve from cached extents, writes go
+        write-back and flush on close()/flush() — single-writer
+        semantics, like rbd_cache without an exclusive-lock
+        arbiter (documented deviation)."""
         self.ioctx = ioctx
         self.name = name
+        self._cache = None
         try:
             meta = ioctx.omap_get_vals(_header_oid(name))
         except (ObjectNotFound, RadosError) as e:
@@ -130,10 +137,26 @@ class Image:
             max_workers=_IO_WORKERS,
             thread_name_prefix=f"rbd.{name}",
         )
+        if cache:
+            # AFTER header validation: a failed open must not leak
+            # the cacher's flusher thread
+            from ..osdc.object_cacher import ObjectCacher
+
+            self._cache = ObjectCacher(ioctx, **(cache_opts or {}))
 
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:
+        # drain in-flight aio FIRST: a queued aio_write must buffer
+        # into a live cacher, not a closed one (its data would be
+        # silently lost)
         self._pool.shutdown(wait=True)
+        if self._cache is not None:
+            self._cache.close()  # flush-on-close (rbd_cache contract)
+
+    def flush(self) -> None:
+        """Barrier all write-back state to the cluster."""
+        if self._cache is not None:
+            self._cache.flush()
 
     def __enter__(self) -> "Image":
         return self
@@ -188,10 +211,12 @@ class Image:
 
         def read_one(ext):
             objectno, obj_off, n = ext
+            oid = _data_oid(self.name, objectno)
+            if self._cache is not None:
+                return self._cache.read(oid, obj_off, n)
             try:
                 data = self.ioctx.read(
-                    _data_oid(self.name, objectno), length=n,
-                    offset=obj_off,
+                    oid, length=n, offset=obj_off
                 )
             except (ObjectNotFound, RadosError):
                 data = b""
@@ -218,9 +243,11 @@ class Image:
 
         def write_one(cut):
             objectno, obj_off, chunk = cut
-            self.ioctx.write(
-                _data_oid(self.name, objectno), chunk, offset=obj_off
-            )
+            oid = _data_oid(self.name, objectno)
+            if self._cache is not None:
+                self._cache.write(oid, obj_off, chunk)
+            else:
+                self.ioctx.write(oid, chunk, offset=obj_off)
 
         list(self._pool.map(write_one, cuts))
         return len(data)
@@ -228,6 +255,8 @@ class Image:
     def discard(self, offset: int, length: int) -> None:
         """Zero a range (librbd discard): whole objects drop, partial
         ranges overwrite with zeros."""
+        if offset < 0 or length < 0:
+            raise RBDError("negative discard extent")
         length = max(0, min(length, self._size - offset))
         if length == 0:
             return
@@ -235,7 +264,15 @@ class Image:
             self.layout, offset, length
         ):
             oid = _data_oid(self.name, objectno)
-            if obj_off == 0 and n == self.layout.object_size:
+            whole = obj_off == 0 and n == self.layout.object_size
+            if self._cache is not None and whole:
+                self._cache.discard(oid)
+            elif self._cache is not None:
+                # partial discard: zero through the cache so no
+                # stale cached bytes survive it
+                self._cache.write(oid, obj_off, b"\0" * n)
+                continue
+            if whole:
                 try:
                     self.ioctx.remove(oid)
                 except (ObjectNotFound, RadosError):
@@ -255,6 +292,9 @@ class Image:
 
     # -- snapshots (pool-snap delegation; documented deviation) ------------
     def snap_create(self, snap_name: str) -> int:
+        # completed writes must be IN the snapshot: barrier the
+        # write-back cache before taking it (rbd_cache contract)
+        self.flush()
         return self.ioctx.snap_create(f"{self.name}@{snap_name}")
 
     def snap_remove(self, snap_name: str) -> None:
@@ -270,7 +310,12 @@ class Image:
 
     def set_snap(self, snap_name: str | None) -> None:
         """Route reads through a snapshot (librbd::Image::snap_set);
-        None returns to the head."""
+        None returns to the head.  The cache cannot distinguish head
+        from snapshot bytes, so it flushes and invalidates on every
+        routing change (librbd flushes+invalidates on snap_set for
+        the same reason)."""
+        if self._cache is not None:
+            self._cache.invalidate_all()
         if snap_name is None:
             self.ioctx.snap_set_read(0)
         else:

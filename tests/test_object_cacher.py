@@ -1,0 +1,220 @@
+"""ObjectCacher — client-side caching with write-back
+(src/osdc/ObjectCacher.cc; VERDICT round-3 'What's missing' item 4)."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.osdc.object_cacher import ObjectCacher
+from ceph_tpu.osdc.objecter import ObjectNotFound
+
+
+class FakeIoctx:
+    """Object-store stand-in counting backend traffic."""
+
+    def __init__(self):
+        self.objects: dict[str, bytearray] = {}
+        self.reads = 0
+        self.writes = 0
+        self.lock = threading.Lock()
+
+    def read(self, oid, length=-1, offset=0):
+        with self.lock:
+            self.reads += 1
+            if oid not in self.objects:
+                raise ObjectNotFound(oid)
+            data = bytes(self.objects[oid])
+        if length < 0:
+            return data[offset:]
+        return data[offset : offset + length]
+
+    def write(self, oid, data, offset=0):
+        with self.lock:
+            self.writes += 1
+            buf = self.objects.setdefault(oid, bytearray())
+            end = offset + len(data)
+            if len(buf) < end:
+                buf.extend(b"\0" * (end - len(buf)))
+            buf[offset:end] = data
+
+
+def test_read_caching_avoids_backend():
+    io = FakeIoctx()
+    io.objects["o"] = bytearray(b"x" * 8192)
+    c = ObjectCacher(io, flush_age=30.0)
+    try:
+        assert c.read("o", 0, 4096) == b"x" * 4096
+        first = io.reads
+        for _ in range(10):
+            assert c.read("o", 0, 4096) == b"x" * 4096
+            assert c.read("o", 1000, 100) == b"x" * 100
+        assert io.reads == first, "cached reads hit the backend"
+        assert c.hits >= 20
+    finally:
+        c.close()
+
+
+def test_writeback_coalesces_and_flushes_on_close():
+    io = FakeIoctx()
+    c = ObjectCacher(io, flush_age=30.0)
+    for i in range(64):
+        c.write("o", i * 64, bytes([i]) * 64)  # 64 adjacent writes
+    assert io.writes == 0, "write-back must not write through"
+    # reads see the dirty data (read-your-writes)
+    assert c.read("o", 100, 8) == bytes([1]) * 8
+    c.close()
+    assert io.writes <= 2, f"coalescing failed: {io.writes} writes"
+    assert bytes(io.objects["o"]) == b"".join(
+        bytes([i]) * 64 for i in range(64)
+    )
+
+
+def test_dirty_limit_throttles_and_flusher_drains():
+    io = FakeIoctx()
+    c = ObjectCacher(
+        io, max_dirty=64 << 10, target_dirty=16 << 10, flush_age=0.1
+    )
+    try:
+        for i in range(64):  # 256KB through a 64KB dirty window
+            c.write(f"o{i % 4}", (i // 4) * 4096, b"d" * 4096)
+        assert c.dirty_bytes <= 64 << 10
+        assert io.writes > 0, "the throttle never flushed"
+        c.flush()
+        assert c.dirty_bytes == 0
+        for i in range(4):
+            want = b"d" * 4096 * 16
+            assert bytes(io.objects[f"o{i}"]) == want
+    finally:
+        c.close()
+
+
+def test_background_flusher_ages_out_dirty():
+    io = FakeIoctx()
+    c = ObjectCacher(io, flush_age=0.2)
+    try:
+        c.write("o", 0, b"age-me")
+        deadline = time.monotonic() + 5.0
+        while io.writes == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert io.writes == 1
+        assert bytes(io.objects["o"]) == b"age-me"
+        assert c.dirty_bytes == 0
+    finally:
+        c.close()
+
+
+def test_eviction_drops_clean_keeps_dirty():
+    io = FakeIoctx()
+    for i in range(8):
+        io.objects[f"c{i}"] = bytearray(b"z" * 8192)
+    c = ObjectCacher(io, max_size=16 << 10, flush_age=30.0)
+    try:
+        for i in range(8):
+            c.read(f"c{i}", 0, 8192)
+        assert c.total_bytes <= 16 << 10
+        c.write("d", 0, b"dirty!" * 100)
+        c.read("c7", 0, 8192)
+        assert c.dirty_bytes == 600  # dirty never evicts
+    finally:
+        c.close()
+
+
+def test_discard_drops_dirty_without_writing():
+    io = FakeIoctx()
+    c = ObjectCacher(io, flush_age=30.0)
+    try:
+        c.write("o", 0, b"doomed")
+        c.discard("o")
+        c.flush()
+        assert io.writes == 0
+        assert "o" not in io.objects
+        assert c.read("o", 0, 6) == b"\0" * 6  # hole semantics
+    finally:
+        c.close()
+
+
+def test_random_ops_match_model():
+    """Randomized read/write/flush sequence against a model buffer —
+    read-your-writes and flush ordering stay exact."""
+    io = FakeIoctx()
+    c = ObjectCacher(
+        io, max_dirty=32 << 10, target_dirty=8 << 10,
+        max_size=64 << 10, flush_age=0.05,
+    )
+    model: dict[str, bytearray] = {}
+    rng = random.Random(42)
+    try:
+        for step in range(400):
+            oid = f"obj{rng.randrange(6)}"
+            off = rng.randrange(0, 16 << 10)
+            n = rng.randrange(1, 2048)
+            if rng.random() < 0.55:
+                data = bytes([step % 251 + 1]) * n
+                c.write(oid, off, data)
+                buf = model.setdefault(oid, bytearray())
+                if len(buf) < off + n:
+                    buf.extend(b"\0" * (off + n - len(buf)))
+                buf[off : off + n] = data
+            else:
+                got = c.read(oid, off, n)
+                want = bytes(
+                    model.get(oid, bytearray())[off : off + n]
+                )
+                want += b"\0" * (n - len(want))
+                assert got == want, (step, oid, off, n)
+            if step % 97 == 0:
+                c.flush()
+        c.close()
+        for oid, buf in model.items():
+            got = bytes(io.objects.get(oid, b""))
+            assert got.ljust(len(buf), b"\0") == bytes(buf), oid
+    finally:
+        pass
+
+
+def test_rbd_image_with_cache_end_to_end():
+    """A cached rbd image over a live cluster: content matches an
+    uncached open, and flush-on-close persists everything."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_osd_daemon import MiniCluster
+    from ceph_tpu.rados import Rados
+    from ceph_tpu.rbd import RBD, Image
+
+    c = MiniCluster()
+    for i in range(3):
+        c.start_osd(i)
+    c.wait_active()
+    try:
+        r = Rados("rbdcache").connect(*c.mon_addr)
+        r.pool_create("rbdp", pg_num=2, size=2)
+        io = r.open_ioctx("rbdp")
+        RBD().create(
+            io, "img", 4 << 20,
+            stripe_unit=1 << 20, object_size=1 << 20,
+        )
+        rng = random.Random(7)
+        model = bytearray(4 << 20)
+        with Image(io, "img", cache=True) as img:
+            for _ in range(40):
+                off = rng.randrange(0, (4 << 20) - 8192)
+                n = rng.randrange(1, 8192)
+                data = bytes([rng.randrange(1, 255)]) * n
+                img.write(off, data)
+                model[off : off + n] = data
+                if rng.random() < 0.3:
+                    got = img.read(off, n)
+                    assert got == data
+            img.flush()
+            assert img.read(0, 4 << 20) == bytes(model)
+        # a FRESH uncached open sees everything (flush-on-close)
+        with Image(io, "img") as img2:
+            assert img2.read(0, 4 << 20) == bytes(model)
+        r.shutdown()
+    finally:
+        c.shutdown()
