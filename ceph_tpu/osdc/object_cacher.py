@@ -119,43 +119,76 @@ class ObjectCacher:
     # -- read path ----------------------------------------------------------
     def read(self, oid: str, offset: int, length: int) -> bytes:
         """Assemble from cache; fetch gaps from the backend (cached
-        clean, holes as zeros).  Returns exactly ``length`` bytes."""
-        with self._lock:
-            gaps = self._gaps(oid, offset, length)
-        for g_off, g_len in gaps:
-            self.misses += 1
-            try:
-                got = self.ioctx.read(oid, length=g_len, offset=g_off)
-            except (ObjectNotFound, RadosError):
-                got = b""
-            buf = bytearray(got) + bytearray(g_len - len(got))
+        clean, holes as zeros).  Returns exactly ``length`` bytes.
+
+        Assembly re-checks coverage under the lock: a concurrent
+        reader's eviction may have dropped extents between the gap
+        scan and the copy, and assembling zeros for data the backend
+        holds would be silent corruption — so uncovered ranges loop
+        back through the fetch."""
+        fetched_any = False
+        for attempt in range(6):
             with self._lock:
-                # a write may have raced into the gap: only fill what
-                # is STILL uncovered, never clobbering newer bytes
-                for s_off, s_len in self._gaps(oid, g_off, g_len):
-                    self._insert(
-                        oid,
-                        _Extent(
-                            s_off,
-                            buf[s_off - g_off : s_off - g_off + s_len],
-                            dirty=False,
-                        ),
+                gaps = self._gaps(oid, offset, length)
+                if not gaps:
+                    if not fetched_any:
+                        self.hits += 1
+                    out = bytearray(length)
+                    for r in self._objects.get(oid, []):
+                        if r.end <= offset or r.off >= offset + length:
+                            continue
+                        s = max(offset, r.off)
+                        e = min(offset + length, r.end)
+                        out[s - offset : e - offset] = r.buf[
+                            s - r.off : e - r.off
+                        ]
+                    self._lru[oid] = time.monotonic()
+                    self._evict_locked()
+                    return bytes(out)
+            fetched_any = True
+            for g_off, g_len in gaps:
+                self.misses += 1
+                try:
+                    got = self.ioctx.read(
+                        oid, length=g_len, offset=g_off
                     )
+                except (ObjectNotFound, RadosError):
+                    got = b""
+                buf = bytearray(got) + bytearray(g_len - len(got))
+                with self._lock:
+                    # a write may have raced into the gap: only fill
+                    # what is STILL uncovered, never clobbering newer
+                    # bytes
+                    for s_off, s_len in self._gaps(oid, g_off, g_len):
+                        self._insert(
+                            oid,
+                            _Extent(
+                                s_off,
+                                buf[
+                                    s_off - g_off : s_off
+                                    - g_off
+                                    + s_len
+                                ],
+                                dirty=False,
+                            ),
+                        )
+        # pathological eviction contention: serve directly from the
+        # backend with the (never-evicted) dirty extents overlaid
+        try:
+            got = self.ioctx.read(oid, length=length, offset=offset)
+        except (ObjectNotFound, RadosError):
+            got = b""
+        out = bytearray(got) + bytearray(length - len(got))
         with self._lock:
-            if not gaps:
-                self.hits += 1
-            out = bytearray(length)
             for r in self._objects.get(oid, []):
-                if r.end <= offset or r.off >= offset + length:
+                if not r.dirty or r.end <= offset or r.off >= offset + length:
                     continue
-                s = max(offset, r.off)
-                e = min(offset + length, r.end)
-                out[s - offset : e - offset] = r.buf[
-                    s - r.off : e - r.off
+                s_ = max(offset, r.off)
+                e_ = min(offset + length, r.end)
+                out[s_ - offset : e_ - offset] = r.buf[
+                    s_ - r.off : e_ - r.off
                 ]
-            self._lru[oid] = time.monotonic()
-            self._evict_locked()
-            return bytes(out)
+        return bytes(out)
 
     def _gaps(self, oid: str, offset: int, length: int):
         gaps = []
@@ -184,8 +217,7 @@ class ObjectCacher:
             # one writer cannot buffer unbounded dirty memory
             deadline = time.monotonic() + 30.0
             while self.dirty_bytes > self.max_dirty:
-                if not self._lock.wait(0.05):
-                    pass
+                self._lock.wait(0.05)
                 if time.monotonic() > deadline:
                     raise RadosError("objectcacher flush stalled")
                 self._flush_some_locked(self.target_dirty)

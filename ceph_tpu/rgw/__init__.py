@@ -27,8 +27,23 @@ Served surface (S3-flavored REST over http.server, the beast role):
     DELETE /<bucket>/<key>           remove
     GET    /<bucket>?marker=&max-keys=   ListObjects (XML, paged)
 
-Deviations, documented: no auth (S3 signatures/keystone/STS), no
-multipart/lifecycle/multisite, single pool.
+    POST   /<bucket>/<key>?uploads      initiate multipart upload
+    PUT    /<bucket>/<key>?uploadId=&partNumber=   upload one part
+    POST   /<bucket>/<key>?uploadId=    complete (manifest head)
+    DELETE /<bucket>/<key>?uploadId=    abort
+
+Auth (round 4): AWS SigV4-shaped request signing (rgw_auth_s3.cc
+role) — users live in an omap-backed store (access key → secret),
+the Authorization header carries credential scope + signed headers +
+signature, the gateway recomputes the signature over the canonical
+request and rejects mismatches/stale dates with 403.  Multipart
+(round 4): parts land as separate rados objects; complete writes a
+MANIFEST head (the reference's multipart manifest), so GET streams
+part reads and the "-N" composite etag matches S3's shape.
+
+Deviations, documented: keystone/STS, lifecycle, multisite, CORS and
+ACLs absent; region/service names checked only for self-consistency;
+single pool.
 """
 
 from __future__ import annotations
@@ -43,9 +58,87 @@ from xml.sax.saxutils import escape
 
 from ..osdc.objecter import ObjectNotFound, RadosError
 
-__all__ = ["RGW", "RGWError"]
+__all__ = ["RGW", "RGWError", "sign_request"]
 
 BUCKETS_DIR = "rgw.buckets"
+USERS_OID = "rgw.users"
+SKEW = 900.0  # max x-amz-date clock skew (seconds)
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    import hmac as hmac_mod
+
+    return hmac_mod.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def _sigv4_key(secret: str, date: str, region: str, service: str):
+    k = _hmac(("AWS4" + secret).encode(), date)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    return _hmac(k, "aws4_request")
+
+
+def _canonical(method, path, query, amz_date, payload_sha) -> str:
+    q = "&".join(
+        f"{urllib.parse.quote(k, safe='')}="
+        f"{urllib.parse.quote(v, safe='')}"
+        for k, v in sorted(query.items())
+    )
+    headers = f"x-amz-content-sha256:{payload_sha}\nx-amz-date:{amz_date}\n"
+    return "\n".join(
+        (
+            method,
+            urllib.parse.quote(path),
+            q,
+            headers,
+            "x-amz-content-sha256;x-amz-date",
+            payload_sha,
+        )
+    )
+
+
+def sign_request(
+    method: str,
+    path: str,
+    query: dict,
+    payload: bytes,
+    access: str,
+    secret: str,
+    region: str = "default",
+    amz_date: str | None = None,
+) -> dict:
+    """Headers for a SigV4-shaped request against the gateway (the
+    client half; boto-equivalent for this reduced dialect)."""
+    amz_date = amz_date or time.strftime(
+        "%Y%m%dT%H%M%SZ", time.gmtime()
+    )
+    date = amz_date[:8]
+    payload_sha = hashlib.sha256(payload).hexdigest()
+    canonical = _canonical(method, path, query, amz_date, payload_sha)
+    scope = f"{date}/{region}/s3/aws4_request"
+    sts = "\n".join(
+        (
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            scope,
+            hashlib.sha256(canonical.encode()).hexdigest(),
+        )
+    )
+    import hmac as hmac_mod
+
+    sig = hmac_mod.new(
+        _sigv4_key(secret, date, region, "s3"), sts.encode(),
+        hashlib.sha256,
+    ).hexdigest()
+    return {
+        "Authorization": (
+            f"AWS4-HMAC-SHA256 Credential={access}/{scope}, "
+            "SignedHeaders=x-amz-content-sha256;x-amz-date, "
+            f"Signature={sig}"
+        ),
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": payload_sha,
+    }
 
 
 class RGWError(Exception):
@@ -60,13 +153,97 @@ def _data_oid(bucket: str, key: str) -> str:
     return f"rgw.obj.{bucket}/{key}"
 
 
+def _mp_oid(bucket: str) -> str:
+    return f"bucket.multipart.{bucket}"
+
+
+def _part_oid(bucket: str, key: str, upload_id: str, n: int) -> str:
+    return f"rgw.part.{bucket}/{key}.{upload_id}.{n:05d}"
+
+
+class AccessDenied(RGWError):
+    pass
+
+
 class RGW:
     """The gateway daemon: storage logic + embedded HTTP frontend."""
 
-    def __init__(self, ioctx):
+    def __init__(self, ioctx, auth: bool = False):
         self.io = ioctx
         self.server = None
         self.port = 0
+        self.auth = auth
+
+    # -- users / auth (rgw_user + rgw_auth_s3 roles) -----------------------
+    def create_user(self, name: str) -> tuple[str, str]:
+        """Provision a user; returns (access_key, secret_key)."""
+        import os as _os
+
+        access = _os.urandom(10).hex().upper()
+        secret = _os.urandom(20).hex()
+        try:
+            self.io.stat(USERS_OID)
+        except (ObjectNotFound, RadosError):
+            self.io.write_full(USERS_OID, b"")
+        self.io.omap_set(
+            USERS_OID,
+            {
+                access: json.dumps(
+                    {"name": name, "secret": secret}
+                ).encode()
+            },
+        )
+        return access, secret
+
+    def _verify(self, method, path, query, headers, payload) -> str:
+        """SigV4 verification; returns the user name or raises
+        AccessDenied (403)."""
+        authz = headers.get("Authorization", "")
+        if not authz.startswith("AWS4-HMAC-SHA256 "):
+            raise AccessDenied("missing SigV4 authorization")
+        fields = {}
+        for part in authz[len("AWS4-HMAC-SHA256 "):].split(","):
+            k, _, v = part.strip().partition("=")
+            fields[k] = v
+        try:
+            access, date, region, service, term = fields[
+                "Credential"
+            ].split("/")
+        except (KeyError, ValueError):
+            raise AccessDenied("malformed credential scope")
+        amz_date = headers.get("x-amz-date", "")
+        payload_sha = headers.get("x-amz-content-sha256", "")
+        if service != "s3" or term != "aws4_request":
+            raise AccessDenied("bad credential scope")
+        if not amz_date.startswith(date):
+            raise AccessDenied("credential date mismatch")
+        import calendar
+
+        try:
+            then = calendar.timegm(
+                time.strptime(amz_date, "%Y%m%dT%H%M%SZ")
+            )
+        except ValueError:
+            raise AccessDenied("bad x-amz-date")
+        if abs(time.time() - then) > SKEW:
+            raise AccessDenied("request time too skewed")
+        if hashlib.sha256(payload).hexdigest() != payload_sha:
+            raise AccessDenied("payload hash mismatch")
+        try:
+            user = json.loads(
+                self.io.omap_get_vals(USERS_OID)[access]
+            )
+        except (KeyError, ObjectNotFound, RadosError):
+            raise AccessDenied("unknown access key")
+        want = sign_request(
+            method, path, query, payload, access, user["secret"],
+            region=region, amz_date=amz_date,
+        )["Authorization"]
+        import hmac as hmac_mod
+
+        if not hmac_mod.compare_digest(want, authz):
+            raise AccessDenied("signature mismatch")
+        return user["name"]
 
     # -- storage logic (rgw_rados roles) -----------------------------------
     def _buckets(self) -> dict[str, bytes]:
@@ -97,6 +274,7 @@ class RGW:
         if bucket not in self._buckets():
             raise RGWError(f"no bucket {bucket!r}")
         etag = hashlib.md5(data).hexdigest()
+        self._drop_object_data(bucket, key)  # stale manifest parts
         self.io.write_full(_data_oid(bucket, key), data)
         # the index entry commits AFTER the data (the reference's
         # prepare/complete index transaction, collapsed)
@@ -116,7 +294,12 @@ class RGW:
 
     def get_object(self, bucket: str, key: str) -> bytes:
         entry = self.stat_object(bucket, key)  # -ENOENT via index
-        data = self.io.read(_data_oid(bucket, key))
+        if "parts" in entry:
+            data = b"".join(
+                self.io.read(oid) for oid in entry["parts"]
+            )
+        else:
+            data = self.io.read(_data_oid(bucket, key))
         if len(data) != entry["size"]:
             raise RGWError(f"{bucket}/{key}: torn object")
         return data
@@ -129,8 +312,144 @@ class RGW:
 
     def delete_object(self, bucket: str, key: str) -> None:
         self.stat_object(bucket, key)
-        self.io.remove(_data_oid(bucket, key))
+        self._drop_object_data(bucket, key)
         self.io.omap_rm_keys(_index_oid(bucket), [key])
+
+    # -- multipart (rgw multipart manifest role) ---------------------------
+    def initiate_multipart(self, bucket: str, key: str) -> str:
+        if bucket not in self._buckets():
+            raise RGWError(f"no bucket {bucket!r}")
+        import os as _os
+
+        upload_id = _os.urandom(8).hex()
+        try:
+            self.io.stat(_mp_oid(bucket))
+        except (ObjectNotFound, RadosError):
+            self.io.write_full(_mp_oid(bucket), b"")
+        self.io.omap_set(
+            _mp_oid(bucket),
+            {f"{key}.{upload_id}": b"open"},
+        )
+        return upload_id
+
+    def _mp_check(self, bucket: str, key: str, upload_id: str) -> None:
+        try:
+            vals = self.io.omap_get_vals(_mp_oid(bucket))
+        except (ObjectNotFound, RadosError):
+            vals = {}
+        if f"{key}.{upload_id}" not in vals:
+            raise RGWError(f"no such upload {upload_id!r}")
+
+    def _mp_parts(
+        self, bucket: str, key: str, upload_id: str
+    ) -> dict[int, dict]:
+        prefix = f"{key}.{upload_id}.part."
+        try:
+            vals = self.io.omap_get_vals(_mp_oid(bucket))
+        except (ObjectNotFound, RadosError):
+            vals = {}
+        return {
+            int(k[len(prefix):]): json.loads(v)
+            for k, v in vals.items()
+            if k.startswith(prefix)
+        }
+
+    def upload_part(
+        self, bucket: str, key: str, upload_id: str, part: int,
+        data: bytes,
+    ) -> str:
+        if not 1 <= part <= 10000:
+            raise RGWError("part number out of range")
+        self._mp_check(bucket, key, upload_id)
+        etag = hashlib.md5(data).hexdigest()
+        self.io.write_full(
+            _part_oid(bucket, key, upload_id, part), data
+        )
+        # ONE omap key per part: concurrent part uploads (the S3
+        # client default) never read-modify-write shared state
+        self.io.omap_set(
+            _mp_oid(bucket),
+            {
+                f"{key}.{upload_id}.part.{part:05d}": json.dumps(
+                    {"etag": etag, "size": len(data)}
+                ).encode()
+            },
+        )
+        return etag
+
+    def complete_multipart(
+        self, bucket: str, key: str, upload_id: str
+    ) -> str:
+        """Write the manifest HEAD: the object's index entry points
+        at the part objects (no data copy), with the S3-shaped
+        composite '-N' etag."""
+        self._mp_check(bucket, key, upload_id)
+        by_num = self._mp_parts(bucket, key, upload_id)
+        if not by_num:
+            raise RGWError("no parts uploaded")
+        parts = sorted(by_num.items())
+        md5s = b"".join(
+            bytes.fromhex(meta["etag"]) for _n, meta in parts
+        )
+        etag = (
+            hashlib.md5(md5s).hexdigest() + f"-{len(parts)}"
+        )
+        self._drop_object_data(bucket, key)  # overwrite semantics
+        self.io.omap_set(
+            _index_oid(bucket),
+            {
+                key: json.dumps(
+                    {
+                        "size": sum(m["size"] for _n, m in parts),
+                        "etag": etag,
+                        "mtime": time.time(),
+                        "parts": [
+                            _part_oid(bucket, key, upload_id, n)
+                            for n, _m in parts
+                        ],
+                    }
+                ).encode()
+            },
+        )
+        self.io.omap_rm_keys(
+            _mp_oid(bucket),
+            [f"{key}.{upload_id}"]
+            + [
+                f"{key}.{upload_id}.part.{n:05d}"
+                for n, _m in parts
+            ],
+        )
+        return etag
+
+    def abort_multipart(
+        self, bucket: str, key: str, upload_id: str
+    ) -> None:
+        self._mp_check(bucket, key, upload_id)
+        by_num = self._mp_parts(bucket, key, upload_id)
+        for n in by_num:
+            try:
+                self.io.remove(_part_oid(bucket, key, upload_id, n))
+            except (ObjectNotFound, RadosError):
+                pass
+        self.io.omap_rm_keys(
+            _mp_oid(bucket),
+            [f"{key}.{upload_id}"]
+            + [
+                f"{key}.{upload_id}.part.{n:05d}" for n in by_num
+            ],
+        )
+
+    def _drop_object_data(self, bucket: str, key: str) -> None:
+        """Remove an existing entry's payload (plain or manifest)."""
+        try:
+            entry = self.stat_object(bucket, key)
+        except ObjectNotFound:
+            return
+        for oid in entry.get("parts", [_data_oid(bucket, key)]):
+            try:
+                self.io.remove(oid)
+            except (ObjectNotFound, RadosError):
+                pass
 
     def list_objects(
         self, bucket: str, marker: str = "", max_keys: int = 1000
@@ -185,11 +504,46 @@ class RGW:
                 parts = parsed.path.strip("/").split("/", 1)
                 bucket = parts[0] if parts[0] else None
                 key = parts[1] if len(parts) > 1 else None
-                q = dict(urllib.parse.parse_qsl(parsed.query))
+                q = dict(
+                    urllib.parse.parse_qsl(
+                        parsed.query, keep_blank_values=True
+                    )
+                )
                 return bucket, key, q
+
+            def _body(self) -> bytes:
+                length = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(length) if length else b""
+
+            def _authorize(self, method, payload) -> bool:
+                """SigV4 gate (when the gateway runs with auth)."""
+                if not gw.auth:
+                    return True
+                parsed = urllib.parse.urlparse(self.path)
+                q = dict(
+                    urllib.parse.parse_qsl(
+                        parsed.query, keep_blank_values=True
+                    )
+                )
+                try:
+                    gw._verify(
+                        method, parsed.path, q,
+                        {
+                            k.lower() if k.lower().startswith("x-amz")
+                            else k: v
+                            for k, v in self.headers.items()
+                        },
+                        payload,
+                    )
+                    return True
+                except AccessDenied as e:
+                    self._err(403, "AccessDenied", str(e))
+                    return False
 
             def do_GET(self):  # noqa: N802
                 bucket, key, q = self._route()
+                if not self._authorize("GET", b""):
+                    return
                 try:
                     if bucket is None:
                         names = sorted(gw._buckets())
@@ -242,6 +596,8 @@ class RGW:
 
             def do_HEAD(self):  # noqa: N802
                 bucket, key, _q = self._route()
+                if not self._authorize("HEAD", b""):
+                    return
                 try:
                     entry = gw.stat_object(bucket, key)
                     self._reply(
@@ -255,11 +611,23 @@ class RGW:
                     self._reply(404)
 
             def do_PUT(self):  # noqa: N802
-                bucket, key, _q = self._route()
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length) if length else b""
+                bucket, key, q = self._route()
+                body = self._body()
+                if not self._authorize("PUT", body):
+                    return
                 try:
-                    if key is None:
+                    if key is not None and "uploadId" in q:
+                        try:
+                            part = int(q.get("partNumber", 0))
+                        except ValueError:
+                            raise RGWError("bad partNumber")
+                        etag = gw.upload_part(
+                            bucket, key, q["uploadId"], part, body,
+                        )
+                        self._reply(
+                            200, b"", headers={"ETag": f'"{etag}"'}
+                        )
+                    elif key is None:
                         gw.create_bucket(bucket)
                         self._reply(200)
                     else:
@@ -270,10 +638,49 @@ class RGW:
                 except RGWError as e:
                     self._err(409, "BucketError", str(e))
 
-            def do_DELETE(self):  # noqa: N802
-                bucket, key, _q = self._route()
+            def do_POST(self):  # noqa: N802
+                bucket, key, q = self._route()
+                body = self._body()
+                if not self._authorize("POST", body):
+                    return
                 try:
-                    if key is None:
+                    if key is not None and "uploads" in q:
+                        upload_id = gw.initiate_multipart(bucket, key)
+                        self._reply(
+                            200,
+                            (
+                                "<InitiateMultipartUploadResult>"
+                                f"<Bucket>{escape(bucket)}</Bucket>"
+                                f"<Key>{escape(key)}</Key>"
+                                f"<UploadId>{upload_id}</UploadId>"
+                                "</InitiateMultipartUploadResult>"
+                            ).encode(),
+                        )
+                    elif key is not None and "uploadId" in q:
+                        etag = gw.complete_multipart(
+                            bucket, key, q["uploadId"]
+                        )
+                        self._reply(
+                            200,
+                            (
+                                "<CompleteMultipartUploadResult>"
+                                f"<ETag>\"{etag}\"</ETag>"
+                                "</CompleteMultipartUploadResult>"
+                            ).encode(),
+                        )
+                    else:
+                        self._err(400, "InvalidRequest", "bad POST")
+                except RGWError as e:
+                    self._err(409, "UploadError", str(e))
+
+            def do_DELETE(self):  # noqa: N802
+                bucket, key, q = self._route()
+                if not self._authorize("DELETE", b""):
+                    return
+                try:
+                    if key is not None and "uploadId" in q:
+                        gw.abort_multipart(bucket, key, q["uploadId"])
+                    elif key is None:
                         gw.delete_bucket(bucket)
                     else:
                         gw.delete_object(bucket, key)
