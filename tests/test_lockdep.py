@@ -1,0 +1,136 @@
+"""lockdep — lock-order cycle detection (src/common/lockdep.cc;
+SURVEY §5.2's race-detection tier)."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from ceph_tpu.common import lockdep
+from ceph_tpu.common.lockdep import LockOrderError, Mutex, RMutex
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    lockdep.reset()
+    lockdep.enable()
+    yield
+    lockdep.disable()
+    lockdep.reset()
+
+
+def test_abba_inversion_caught_on_first_run():
+    """The whole point: an AB/BA inversion raises on the SECOND code
+    path's first execution — no unlucky interleaving needed."""
+    a, b = Mutex("A"), Mutex("B")
+    with a:
+        with b:
+            pass
+    with pytest.raises(LockOrderError, match="inversion"):
+        with b:
+            with a:
+                pass
+
+
+def test_transitive_cycles_detected():
+    a, b, c = Mutex("A"), Mutex("B"), Mutex("C")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    # A -> B -> C established; C -> A closes the triangle
+    with pytest.raises(LockOrderError, match="A -> B -> C"):
+        with c:
+            with a:
+                pass
+
+
+def test_consistent_order_never_fires():
+    a, b, c = Mutex("A"), Mutex("B"), Mutex("C")
+    for _ in range(50):
+        with a:
+            with b:
+                with c:
+                    pass
+        with a:
+            with c:
+                pass
+        with b:
+            with c:
+                pass
+
+
+def test_per_thread_held_sets():
+    """Holding in ONE thread only orders that thread's acquires —
+    another thread taking B alone then A alone is fine."""
+    a, b = Mutex("A"), Mutex("B")
+    with a:
+        with b:
+            pass
+    errs = []
+
+    def other():
+        try:
+            with b:
+                pass
+            with a:
+                pass
+        except LockOrderError as e:  # pragma: no cover
+            errs.append(e)
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join(5)
+    assert errs == []
+
+
+def test_rmutex_recursion_allowed():
+    r = RMutex("R")
+    with r:
+        with r:  # recursive re-take of the same class: not a cycle
+            with r:
+                pass
+
+
+def test_nested_same_class_nonrecursive_flagged():
+    """Two INSTANCES of one non-recursive class nested in ONE thread:
+    that is the classic two-PG ABBA shape (thread 1: pg1 then pg2;
+    thread 2: pg2 then pg1 deadlocks) — flagged immediately from one
+    thread's behavior, like the reference's lockdep."""
+    pg1, pg2 = Mutex("pg-lock"), Mutex("pg-lock")
+    with pg1:
+        with pytest.raises(LockOrderError, match="non-recursive"):
+            pg2.acquire()
+
+
+def test_disable_mid_hold_leaves_no_phantoms():
+    """An acquire tracked before disable() must unwind cleanly: no
+    phantom held entries poisoning later edges after re-enable."""
+    m, x = Mutex("M"), Mutex("X")
+    m.acquire()
+    lockdep.disable()
+    m.release()
+    lockdep.enable()
+    with x:  # must NOT record a phantom M -> X edge
+        pass
+    with m:
+        with x:
+            pass
+    with pytest.raises(LockOrderError):
+        with x:
+            with m:
+                pass
+
+
+def test_disabled_is_transparent():
+    lockdep.disable()
+    a, b = Mutex("A"), Mutex("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # no tracking when disabled
+            pass
