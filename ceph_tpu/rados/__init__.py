@@ -199,10 +199,19 @@ class IoCtx:
             snap_seq=self.write_snap_seq,
         )
 
-    def read(self, oid: str, length: int = -1, offset: int = 0) -> bytes:
+    def read(
+        self,
+        oid: str,
+        length: int = -1,
+        offset: int = 0,
+        snapid: int | None = None,
+    ) -> bytes:
+        """``snapid`` overrides the ioctx read context for ONE call
+        (rbd clone parent reads pin their parent snap this way)."""
         reply = self.rados.objecter.op_submit(
             self.pool_id, oid, OSD_OP_READ, offset=offset,
-            length=length, snapid=self.read_snap,
+            length=length,
+            snapid=self.read_snap if snapid is None else snapid,
         )
         return reply.data
 

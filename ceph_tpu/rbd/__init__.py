@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import json
+import threading
 
 from ..osdc.striper import StripeLayout, map_extent
 from ..osdc.objecter import ObjectNotFound, RadosError
@@ -80,6 +81,65 @@ class RBD:
         )
         ioctx.omap_set(DIRECTORY, {name: b"1"})
 
+    def clone(
+        self,
+        ioctx,
+        parent_name: str,
+        parent_snap: str,
+        child_name: str,
+    ) -> None:
+        """COW clone of a parent image snapshot (librbd layering,
+        librbd/Operations.cc clone): the child starts as pure
+        metadata — reads fall through to the parent AT THE SNAP for
+        objects the child has never written, writes copy-up the
+        parent object first (object-granular COW, exactly the
+        reference's granularity).  Deviations: no protect/unprotect
+        gate and no children registry — removing a parent (or its
+        snap) under live clones is the operator's misstep to avoid;
+        flatten() severs the dependency."""
+        try:
+            pmeta = ioctx.omap_get_vals(_header_oid(parent_name))
+        except (ObjectNotFound, RadosError) as e:
+            raise RBDError(f"parent {parent_name!r} not found: {e}")
+        if "parent" in pmeta:
+            # a clone of an unflattened clone would need recursive
+            # read-through; flatten the middle image first
+            raise RBDError(
+                f"parent {parent_name!r} is itself a clone — "
+                "flatten it before cloning (-EINVAL)"
+            )
+        snap_full = f"{parent_name}@{parent_snap}"
+        snaps = {n: s for s, n in ioctx.snap_list().items()}
+        if snap_full not in snaps:
+            raise RBDError(
+                f"parent snap {parent_snap!r} not found (-ENOENT)"
+            )
+        existing = ioctx.omap_get_vals(DIRECTORY) if self._dir_exists(
+            ioctx
+        ) else {}
+        if child_name in existing:
+            raise RBDError(f"image {child_name!r} exists (-EEXIST)")
+        psize = int(pmeta["size"])
+        ioctx.write_full(_header_oid(child_name), b"")
+        ioctx.omap_set(
+            _header_oid(child_name),
+            {
+                "size": pmeta["size"],
+                "stripe_unit": pmeta["stripe_unit"],
+                "stripe_count": pmeta["stripe_count"],
+                "object_size": pmeta["object_size"],
+                "parent": json.dumps(
+                    {
+                        "name": parent_name,
+                        "snap": parent_snap,
+                        "snapid": snaps[snap_full],
+                        "size": psize,
+                    }
+                ).encode(),
+            },
+        )
+        ioctx.omap_set(DIRECTORY, {child_name: b"1"})
+
     @staticmethod
     def _dir_exists(ioctx) -> bool:
         try:
@@ -128,6 +188,11 @@ class Image:
         if "size" not in meta:
             raise RBDError(f"image {name!r} has no header metadata")
         self._size = int(meta["size"])
+        self.parent = (
+            json.loads(meta["parent"]) if "parent" in meta else None
+        )
+        self._copyup_lock = threading.Lock()
+        self._copyup_locks: dict[int, threading.Lock] = {}
         self.layout = StripeLayout(
             int(meta["stripe_unit"]),
             int(meta["stripe_count"]),
@@ -138,6 +203,13 @@ class Image:
             thread_name_prefix=f"rbd.{name}",
         )
         if cache:
+            if self.parent is not None:
+                # the cacher cannot see parent read-through/copy-up;
+                # silently uncached IO would betray cache=True
+                raise RBDError(
+                    "cache=True unsupported on an unflattened clone "
+                    "(flatten first) (-EINVAL)"
+                )
             # AFTER header validation: a failed open must not leak
             # the cacher's flusher thread
             from ..osdc.object_cacher import ObjectCacher
@@ -219,11 +291,55 @@ class Image:
                     oid, length=n, offset=obj_off
                 )
             except (ObjectNotFound, RadosError):
+                if self.parent is not None:
+                    return self._parent_read(objectno, obj_off, n)
                 data = b""
             return data + b"\0" * (n - len(data))
 
         parts = list(self._pool.map(read_one, extents))
         return b"".join(parts)
+
+    def _parent_read(self, objectno: int, obj_off: int, n: int) -> bytes:
+        """Read-through to the parent snapshot for an object the
+        child never wrote (librbd's parent overlap read)."""
+        p = self.parent
+        # no explicit overlap bound: beyond-parent ranges simply have
+        # no parent object bytes and zero-fill below (a computed
+        # bound would need the inverse striper map for
+        # stripe_count > 1 and gets it wrong otherwise)
+        try:
+            data = self.ioctx.read(
+                _data_oid(p["name"], objectno), length=n,
+                offset=obj_off, snapid=p["snapid"],
+            )
+        except (ObjectNotFound, RadosError):
+            data = b""
+        return data + b"\0" * (n - len(data))
+
+    def _copy_up(self, objectno: int) -> None:
+        """First write to an inherited object materializes the whole
+        parent object in the child (librbd copy-up) so the child
+        object fully shadows the parent from then on.  Serialized per
+        object: concurrent stripes of one write (or parallel aio)
+        must not let a late write_full of the parent base clobber a
+        sibling's already-written chunk."""
+        with self._copyup_lock:
+            lock = self._copyup_locks.setdefault(
+                objectno, threading.Lock()
+            )
+        with lock:
+            oid = _data_oid(self.name, objectno)
+            try:
+                self.ioctx.stat(oid)
+                return  # child already owns this object
+            except (ObjectNotFound, RadosError):
+                pass
+            base = self._parent_read(
+                objectno, 0, self.layout.object_size
+            ).rstrip(b"\0")
+            # write even when empty: the object's EXISTENCE is the
+            # shadow
+            self.ioctx.write_full(oid, base)
 
     def write(self, offset: int, data: bytes) -> int:
         if offset < 0:
@@ -244,6 +360,8 @@ class Image:
         def write_one(cut):
             objectno, obj_off, chunk = cut
             oid = _data_oid(self.name, objectno)
+            if self.parent is not None:
+                self._copy_up(objectno)
             if self._cache is not None:
                 self._cache.write(oid, obj_off, chunk)
             else:
@@ -265,6 +383,15 @@ class Image:
         ):
             oid = _data_oid(self.name, objectno)
             whole = obj_off == 0 and n == self.layout.object_size
+            if self.parent is not None:
+                # removing the child object would RESURRECT parent
+                # data; a clone's discard writes zeros instead
+                self._copy_up(objectno)
+                try:
+                    self.ioctx.write(oid, b"\0" * n, offset=obj_off)
+                except RadosError:
+                    pass
+                continue
             if self._cache is not None and whole:
                 self._cache.discard(oid)
             elif self._cache is not None:
@@ -282,6 +409,18 @@ class Image:
                     self.ioctx.write(oid, b"\0" * n, offset=obj_off)
                 except RadosError:
                     pass
+
+    def flatten(self) -> None:
+        """Copy every still-inherited object down from the parent and
+        sever the dependency (librbd flatten): afterwards the child
+        is a standalone image and the parent/snap may be retired."""
+        if self.parent is None:
+            return
+        list(
+            self._pool.map(self._copy_up, range(self._max_objects()))
+        )
+        self.ioctx.omap_rm_keys(_header_oid(self.name), ["parent"])
+        self.parent = None
 
     # -- aio (librbd completions) ------------------------------------------
     def aio_read(self, offset: int, length: int):

@@ -170,3 +170,106 @@ def test_image_on_erasure_pool(client):
         img.write(1234, data)
         assert img.read(1234, len(data)) == data
         assert img.read(0, 8) == b"\0" * 8
+
+
+def test_clone_copy_up_and_flatten(cluster):
+    """librbd layering (round 4): a COW clone of a parent snapshot
+    reads through to the parent, copy-ups on first write, hides
+    parent data on discard, and flatten() severs the dependency."""
+    import json as _json
+
+    r = Rados("rbd-clone").connect(*cluster.mon_addr)
+    try:
+        r.pool_create("clonepool", pg_num=2, size=2)
+        io = r.open_ioctx("clonepool")
+        rbd = RBD()
+        rbd.create(
+            io, "parent", 4 << 20,
+            stripe_unit=1 << 20, object_size=1 << 20,
+        )
+        with Image(io, "parent") as p:
+            p.write(0, b"P0" * 1000)
+            p.write(1 << 20, b"P1" * 1000)
+            p.snap_create("base")
+            # post-snap parent writes must NOT leak into the clone
+            p.write(0, b"XX" * 1000)
+
+        rbd.clone(io, "parent", "base", "child")
+        with Image(io, "child") as c:
+            assert c.parent["name"] == "parent"
+            # read-through serves the SNAPSHOT state
+            assert c.read(0, 2000) == b"P0" * 1000
+            assert c.read(1 << 20, 2000) == b"P1" * 1000
+            assert c.read(2 << 20, 16) == b"\0" * 16  # parent hole
+            # first write copy-ups the object: the rest of the object
+            # keeps the parent bytes, the write shadows its range
+            c.write(100, b"c" * 10)
+            got = c.read(0, 2000)
+            assert got[:100] == (b"P0" * 1000)[:100]
+            assert got[100:110] == b"c" * 10
+            assert got[110:] == (b"P0" * 1000)[110:]
+            # parent unchanged by child writes (fresh ioctx: the
+            # snap read context is per-ioctx, as in librbd)
+            io2 = r.open_ioctx("clonepool")
+            with Image(io2, "parent") as p2:
+                p2.set_snap("base")
+                assert p2.read(0, 2000) == b"P0" * 1000
+            # discard on a clone hides parent data (no resurrection)
+            c.discard(1 << 20, 1 << 20)
+            assert c.read(1 << 20, 2000) == b"\0" * 2000
+
+            # flatten: child becomes standalone
+            c.flatten()
+            assert c.parent is None
+        meta = io.omap_get_vals("rbd_header.child")
+        assert "parent" not in meta
+        with Image(io, "child") as c2:
+            assert c2.read(0, 100) == (b"P0" * 1000)[:100]
+            assert c2.read(1 << 20, 100) == b"\0" * 100
+    finally:
+        r.shutdown()
+
+
+def test_clone_of_striped_parent(cluster):
+    """stripe_count > 1: the striper's object/offset mapping differs
+    from the naive objectno*object_size math — clone read-through and
+    copy-up must stay exact across stripe boundaries."""
+    r = Rados("rbd-stripe-clone").connect(*cluster.mon_addr)
+    try:
+        r.pool_create("stripeclone", pg_num=2, size=2)
+        io = r.open_ioctx("stripeclone")
+        rbd = RBD()
+        rbd.create(
+            io, "sp", 4 << 20,
+            stripe_unit=1 << 19, stripe_count=2,
+            object_size=1 << 20,
+        )
+        pattern = bytes(range(256)) * (4 << 12)  # 4MB deterministic
+        with Image(io, "sp") as p:
+            p.write(0, pattern)
+            p.snap_create("s")
+        rbd.clone(io, "sp", "s", "spc")
+        with Image(io, "spc") as c:
+            # reads across stripe boundaries match the parent exactly
+            for off, n in (
+                (0, 4 << 20),
+                ((1 << 19) - 100, 300),
+                ((1 << 20) + 7, 5000),
+                ((3 << 20) - 1, 2),
+            ):
+                assert c.read(off, n) == pattern[off : off + n], off
+            # a write mid-stripe copy-ups without corrupting siblings
+            c.write((1 << 19) + 50, b"EDIT")
+            want = bytearray(pattern)
+            want[(1 << 19) + 50 : (1 << 19) + 54] = b"EDIT"
+            assert c.read(0, 4 << 20) == bytes(want)
+            c.flatten()
+            assert c.read(0, 4 << 20) == bytes(want)
+        # cloning an unflattened clone is refused
+        with Image(io, "spc") as c2:
+            c2.snap_create("cs")
+        rbd.clone(io, "spc", "cs", "grandchild")  # spc is flattened: ok
+        with pytest.raises(RBDError, match="not found"):
+            rbd.clone(io, "nonexistent", "s", "x")
+    finally:
+        r.shutdown()
