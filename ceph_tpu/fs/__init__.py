@@ -18,10 +18,17 @@ Surface (the libcephfs/Client.cc verbs): mkdir/rmdir/readdir,
 create/open/unlink/rename, read/write (sparse, striped), stat,
 truncate.
 
-Deviations, documented: no MDS daemon — metadata ops are client-side
-library calls against the metadata pool (single-writer semantics; no
-capabilities/locking/journal, no multi-MDS subtree partitioning), and
-no snapshots at the file layer.
+Snapshots (round 4): ``snapshot(name)`` freezes the WHOLE filesystem
+by snapshotting the metadata and data pools together (the pool-snap
+delegation the rbd layer uses), and ``at_snap(name)`` returns a
+READ-ONLY mount whose every lookup/readdir/read resolves at that
+moment — metadata omaps and striped data objects alike ride the
+clone-resolution machinery.  Deviation vs the reference's .snap
+dirs: snapshots are filesystem-global, not per-directory snaprealms.
+
+Deviations, documented: the MDS tier (ceph_tpu.mds) carries
+capabilities/journal/failover; THIS module is the library-mode
+single-writer client.
 """
 
 from __future__ import annotations
@@ -296,6 +303,38 @@ class CephFS:
         self.meta.remove(_ino_oid(ino))
         self.meta.omap_rm_keys(_dir_oid(parent), [name])
 
+    # -- snapshots (pool-snap delegation) ----------------------------------
+    def snapshot(self, name: str) -> None:
+        """Freeze the filesystem: one pool snap on the metadata pool
+        and (when distinct) the data pool, under the fs namespace
+        ``fs@<name>``."""
+        self.meta.snap_create(f"fs@{name}")
+        if self._distinct_data_pool():
+            self.data.snap_create(f"fs@{name}")
+
+    def _distinct_data_pool(self) -> bool:
+        # POOL identity, not ioctx identity: two ioctxs over one pool
+        # must not double-snap it
+        return self.data.pool_id != self.meta.pool_id
+
+    def remove_snapshot(self, name: str) -> None:
+        self.meta.snap_remove(f"fs@{name}")
+        if self._distinct_data_pool():
+            self.data.snap_remove(f"fs@{name}")
+
+    def list_snapshots(self) -> list[str]:
+        return sorted(
+            n[len("fs@"):]
+            for n in self.meta.snap_list().values()
+            if n.startswith("fs@")
+        )
+
+    def at_snap(self, name: str) -> "SnapMount":
+        """A read-only view of the filesystem as of ``snapshot(name)``."""
+        if name not in self.list_snapshots():
+            raise NotFound(f"no fs snapshot {name!r} (-ENOENT)")
+        return SnapMount(self, f"fs@{name}")
+
     def rename(self, src: str, dst: str) -> None:
         sparent, sname = self._parent_of(src)
         dparent, dname = self._parent_of(dst)
@@ -309,3 +348,41 @@ class CephFS:
             _dir_oid(dparent), {dname: json.dumps(dentry).encode()}
         )
         self.meta.omap_rm_keys(_dir_oid(sparent), [sname])
+
+
+class SnapMount(CephFS):
+    """Read-only mount at a filesystem snapshot: the same client code
+    with both ioctx read contexts pinned to the snap (a fresh ioctx
+    pair, so the live mount's contexts stay untouched), and every
+    mutating verb refused."""
+
+    _RO = (
+        "mkdir", "rmdir", "create", "write", "truncate",
+        "unlink", "rename", "snapshot", "remove_snapshot",
+    )
+
+    def __init__(self, live: "CephFS", snap_full: str):
+        meta = live.meta.rados.open_ioctx(
+            live.meta.rados.monc.osdmap.pool_names[live.meta.pool_id]
+        )
+        meta.snap_set_read(snap_full)
+        if live.data.pool_id == live.meta.pool_id:
+            data = meta
+        else:
+            data = live.data.rados.open_ioctx(
+                live.data.rados.monc.osdmap.pool_names[
+                    live.data.pool_id
+                ]
+            )
+            data.snap_set_read(snap_full)
+        self.meta = meta
+        self.data = data
+        self.layout = live.layout
+        # NO _mkfs_if_needed: a snapshot view never writes
+
+    def __getattribute__(self, name):
+        if name in SnapMount._RO:
+            raise FSError(
+                f"{name}: read-only snapshot mount (-EROFS)"
+            )
+        return super().__getattribute__(name)

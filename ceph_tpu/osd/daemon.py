@@ -929,7 +929,8 @@ class OSD(Dispatcher):
         is_ec = self._is_ec(pg)
         try:
             if msg.op in (
-                OSD_OP_READ, OSD_OP_STAT, OSD_OP_GETXATTR
+                OSD_OP_READ, OSD_OP_STAT, OSD_OP_GETXATTR,
+                OSD_OP_OMAPGET,
             ) and msg.snapid:
                 # reads at a snap serve from the covering clone
                 store_oid = self._resolve_snap_read(

@@ -368,11 +368,16 @@ class IoCtx:
         )
 
     def omap_get_vals(
-        self, oid: str, start_after: str = "", max_return: int = -1
+        self,
+        oid: str,
+        start_after: str = "",
+        max_return: int = -1,
+        snapid: int | None = None,
     ) -> dict[str, bytes]:
         reply = self.rados.objecter.op_submit(
             self.pool_id, oid, OSD_OP_OMAPGET,
             attr=start_after, length=max_return,
+            snapid=self.read_snap if snapid is None else snapid,
         )
         return Decoder(reply.data).map(
             lambda d: d.string(), lambda d: d.bytes()

@@ -97,8 +97,18 @@ class RBD:
         gate and no children registry — removing a parent (or its
         snap) under live clones is the operator's misstep to avoid;
         flatten() severs the dependency."""
+        snap_full = f"{parent_name}@{parent_snap}"
+        snaps = {n: s for s, n in ioctx.snap_list().items()}
+        if snap_full not in snaps:
+            raise RBDError(
+                f"parent snap {parent_snap!r} not found (-ENOENT)"
+            )
         try:
-            pmeta = ioctx.omap_get_vals(_header_oid(parent_name))
+            # the header AT THE SNAP: a parent resized after the
+            # snapshot must not leak its head size into the child
+            pmeta = ioctx.omap_get_vals(
+                _header_oid(parent_name), snapid=snaps[snap_full]
+            )
         except (ObjectNotFound, RadosError) as e:
             raise RBDError(f"parent {parent_name!r} not found: {e}")
         if "parent" in pmeta:
@@ -107,12 +117,6 @@ class RBD:
             raise RBDError(
                 f"parent {parent_name!r} is itself a clone — "
                 "flatten it before cloning (-EINVAL)"
-            )
-        snap_full = f"{parent_name}@{parent_snap}"
-        snaps = {n: s for s, n in ioctx.snap_list().items()}
-        if snap_full not in snaps:
-            raise RBDError(
-                f"parent snap {parent_snap!r} not found (-ENOENT)"
             )
         existing = ioctx.omap_get_vals(DIRECTORY) if self._dir_exists(
             ioctx
@@ -360,7 +364,11 @@ class Image:
         def write_one(cut):
             objectno, obj_off, chunk = cut
             oid = _data_oid(self.name, objectno)
-            if self.parent is not None:
+            if self.parent is not None and not (
+                obj_off == 0 and len(chunk) == self.layout.object_size
+            ):
+                # partial writes copy-up; a full-object write fully
+                # shadows the parent by itself (librbd skips too)
                 self._copy_up(objectno)
             if self._cache is not None:
                 self._cache.write(oid, obj_off, chunk)
@@ -385,12 +393,11 @@ class Image:
             whole = obj_off == 0 and n == self.layout.object_size
             if self.parent is not None:
                 # removing the child object would RESURRECT parent
-                # data; a clone's discard writes zeros instead
+                # data; a clone's discard writes zeros instead — and
+                # a FAILED zeroing must surface (swallowing it would
+                # be exactly the resurrection this path prevents)
                 self._copy_up(objectno)
-                try:
-                    self.ioctx.write(oid, b"\0" * n, offset=obj_off)
-                except RadosError:
-                    pass
+                self.ioctx.write(oid, b"\0" * n, offset=obj_off)
                 continue
             if self._cache is not None and whole:
                 self._cache.discard(oid)

@@ -323,7 +323,7 @@ def test_clay_fractional_recovery_through_daemon():
             assert io.read("frac") == data
             reads.clear()
             c.start_osd(victim)
-            deadline = time.monotonic() + 25.0
+            deadline = time.monotonic() + 60.0  # 1-core suite load
             got = False
             while not got and time.monotonic() < deadline:
                 for cid in victim_store.list_collections():

@@ -283,3 +283,52 @@ def test_rgw_sigv4_auth_and_multipart(cluster):
         r.shutdown()
     finally:
         pass
+
+
+def test_fs_snapshots_and_readonly_mounts(cluster):
+    """Round-4 file-layer snapshots: snapshot() freezes the whole
+    namespace + data; at_snap() mounts a read-only view that keeps
+    serving the frozen state while the live mount keeps mutating."""
+    from ceph_tpu.fs import CephFS, FSError
+
+    r = Rados("fs-snap").connect(*cluster.mon_addr)
+    try:
+        r.pool_create("fssnap", pg_num=2, size=2)
+        io = r.open_ioctx("fssnap")
+        fs = CephFS(io)
+        fs.mkdir("/proj")
+        fs.create("/proj/a.txt")
+        fs.write("/proj/a.txt", 0, b"version one")
+        fs.snapshot("v1")
+        assert fs.list_snapshots() == ["v1"]
+
+        # live mount moves on
+        fs.write("/proj/a.txt", 0, b"VERSION TWO")
+        fs.create("/proj/b.txt")
+        fs.mkdir("/proj/later")
+        fs.unlink("/proj/a.txt")
+
+        snap = fs.at_snap("v1")
+        assert snap.readdir("/proj") == ["a.txt"]
+        assert snap.read("/proj/a.txt") == b"version one"
+        assert snap.stat("/proj/a.txt")["size"] == 11
+        # read-only: every mutation refused
+        with pytest.raises(FSError, match="read-only"):
+            snap.create("/proj/nope")
+        with pytest.raises(FSError, match="read-only"):
+            snap.write("/proj/a.txt", 0, b"x")
+        with pytest.raises(FSError, match="read-only"):
+            snap.mkdir("/zzz")
+
+        # the live mount still sees the new world
+        assert sorted(fs.readdir("/proj")) == ["b.txt", "later"]
+
+        # a second snapshot stacks; removal retires the first
+        fs.snapshot("v2")
+        assert fs.at_snap("v2").readdir("/proj") == ["b.txt", "later"]
+        fs.remove_snapshot("v1")
+        assert fs.list_snapshots() == ["v2"]
+        with pytest.raises(Exception):
+            fs.at_snap("v1")
+    finally:
+        r.shutdown()

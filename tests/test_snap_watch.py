@@ -208,6 +208,17 @@ def test_cls_lock_notifies_on_unlock(cluster, client):
 def test_snapshots_on_erasure_pool(cluster, client):
     """The clone op copies each position's local shard, so EC heads
     snapshot through the same machinery."""
+    # EC pool creation + peering under full-suite load on one core
+    # can outrun the default 15s op timeout (observed flake)
+    saved_timeout = client.objecter.op_timeout
+    client.objecter.op_timeout = 60.0
+    try:
+        _ec_snapshot_walk(client)
+    finally:
+        client.objecter.op_timeout = saved_timeout
+
+
+def _ec_snapshot_walk(client):
     rc, _outb, outs = client.mon_command(
         {
             "prefix": "osd erasure-code-profile set",
