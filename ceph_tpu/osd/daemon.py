@@ -87,6 +87,7 @@ from .scheduler import (
     CLASS_BACKGROUND,
     CLASS_CLIENT,
     CLASS_RECOVERY,
+    MClockQueue,
     WeightedPriorityQueue,
 )
 from ..msg.message import (
@@ -222,6 +223,7 @@ class OSD(Dispatcher):
         scrub_interval: float = 0.0,
         recovery_max_active: int = 3,
         client_message_cap: int = 256 << 20,
+        op_queue: str = "wpq",
     ):
         """``scrub_interval`` > 0 arms tick-driven scrub scheduling
         (osd_scrub_min_interval); ``recovery_max_active`` caps
@@ -237,8 +239,16 @@ class OSD(Dispatcher):
         self._pg_lock = threading.RLock()
         # the op worker drains a QoS-classed scheduler, not a FIFO:
         # peering/map events are strict, client ops and background
-        # work (scrub, splits) share by weight (OpScheduler role)
-        self._workq = WeightedPriorityQueue()
+        # work (scrub, splits) share by weight or by dmclock QoS
+        # (osd_op_queue: wpq | mclock_scheduler)
+        if op_queue in ("mclock", "mclock_scheduler"):
+            self._workq = MClockQueue()
+        elif op_queue == "wpq":
+            self._workq = WeightedPriorityQueue()
+        else:
+            raise ValueError(
+                f"unknown op_queue {op_queue!r} (wpq | mclock)"
+            )
         # client-message admission control (osd_client_message_size_
         # cap role): over-budget ops are bounced with -EAGAIN (the
         # objecter retries), so one firehose client cannot queue the

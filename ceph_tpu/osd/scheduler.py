@@ -38,33 +38,64 @@ DEFAULT_WEIGHTS = {
 }
 
 
-class WeightedPriorityQueue:
-    """Strict + deficit-weighted-round-robin work queue."""
+class _SchedulerBase:
+    """Shared scheduler chassis: the strict deque (peering/map events
+    preempt all QoS), the drain-aware shutdown sentinel, and the
+    queue.Queue-shaped put/get aliases — subclasses supply only the
+    weighted enqueue and pick policy."""
 
-    def __init__(self, weights: dict[str, int] | None = None):
+    def __init__(self, classes):
         self._draining = False
-        self.weights = dict(weights or DEFAULT_WEIGHTS)
         self._strict: collections.deque = collections.deque()
         self._queues: dict[str, collections.deque] = {
-            k: collections.deque() for k in self.weights
+            k: collections.deque() for k in classes
         }
-        self._credit: dict[str, float] = {k: 0.0 for k in self.weights}
-        self._rr = list(self.weights)  # round-robin order
-        self._rr_pos = 0
-        self._fresh = True  # current class not yet granted this visit
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._size = 0
 
-    # -- OpScheduler surface ----------------------------------------------
     def enqueue(self, klass: str, cost: int, item) -> None:
         with self._cond:
             if klass == CLASS_STRICT or klass not in self._queues:
                 self._strict.append(item)
             else:
-                self._queues[klass].append((max(int(cost), 1), item))
+                self._enqueue_weighted(klass, max(int(cost), 1), item)
             self._size += 1
             self._cond.notify()
+
+    def qlen(self) -> int:
+        with self._lock:
+            return self._size
+
+    def put(self, item) -> None:
+        """None marks the queue DRAINING — the consumer sees it only
+        once everything already queued has been served (queue.Queue's
+        FIFO sentinel semantics the daemon's shutdown relies on);
+        legacy tuples go strict."""
+        if item is None:
+            with self._cond:
+                self._draining = True
+                self._cond.notify_all()
+            return
+        self.enqueue(CLASS_STRICT, 0, item)
+
+    def get(self, timeout: float | None = None):
+        return self.dequeue(timeout)
+
+
+class WeightedPriorityQueue(_SchedulerBase):
+    """Strict + deficit-weighted-round-robin work queue."""
+
+    def __init__(self, weights: dict[str, int] | None = None):
+        self.weights = dict(weights or DEFAULT_WEIGHTS)
+        super().__init__(self.weights)
+        self._credit: dict[str, float] = {k: 0.0 for k in self.weights}
+        self._rr = list(self.weights)  # round-robin order
+        self._rr_pos = 0
+        self._fresh = True  # current class not yet granted this visit
+
+    def _enqueue_weighted(self, klass: str, cost: int, item) -> None:
+        self._queues[klass].append((cost, item))
 
     def dequeue(self, timeout: float | None = None):
         with self._cond:
@@ -119,23 +150,144 @@ class WeightedPriorityQueue:
             self._credit[best[1]] = 0.0
             return item
 
-    def qlen(self) -> int:
-        with self._lock:
-            return self._size
 
-    # -- queue.Queue-shaped aliases (the daemon's producer surface) --------
-    def put(self, item) -> None:
-        """Untyped put: legacy tuples go strict; None marks the queue
-        DRAINING — the consumer sees it only once everything already
-        queued has been served (queue.Queue's FIFO sentinel
-        semantics, which the daemon's shutdown relies on: queued ops
-        still get replies and release their throttle budget)."""
-        if item is None:
-            with self._cond:
-                self._draining = True
-                self._cond.notify_all()
-            return
-        self.enqueue(CLASS_STRICT, 0, item)
+class MClockQueue(_SchedulerBase):
+    """dmClock-style QoS queue (the mclock_scheduler role,
+    src/osd/scheduler/mClockScheduler.cc over the dmclock library) —
+    the reference's DEFAULT osd_op_queue.
 
-    def get(self, timeout: float | None = None):
-        return self.dequeue(timeout)
+    Each class gets (reservation, weight, limit) in cost-units/sec:
+
+    - reservation: guaranteed rate — requests whose reservation tag
+      has come due are served FIRST, in tag order, regardless of
+      weights (the qos floor);
+    - limit: hard cap — a request whose limit tag lies in the future
+      is ineligible even when the worker idles (anti-starvation for
+      OTHER consumers of the device behind this queue);
+    - weight: proportional share of whatever capacity remains.
+
+    Tags advance by cost/rate per request (dmclock's RhoPhi tags with
+    delta/rho collapsed for the single-server case).  The clock is
+    injectable so QoS tests drive virtual time deterministically.
+    Strict items (peering/map events) bypass QoS entirely, and the
+    drain-aware ``put(None)`` sentinel matches WeightedPriorityQueue.
+    """
+
+    def __init__(
+        self,
+        profiles: dict[str, tuple[float, float, float]] | None = None,
+        clock=None,
+        cost_unit: float = 4096.0,
+    ):
+        import time as _time
+
+        # (reservation, weight, limit) per class in COST-UNITS/sec;
+        # limit 0 = none.  The daemon enqueues BYTE costs, so
+        # cost_unit converts (default: one 4KB op = one unit).  The
+        # defaults cap only background work — a default limit on
+        # recovery would stall pulls outright when uncontended.
+        self.profiles = dict(
+            profiles
+            or {
+                CLASS_CLIENT: (100.0, 60.0, 0.0),
+                CLASS_RECOVERY: (20.0, 20.0, 0.0),
+                CLASS_BACKGROUND: (5.0, 10.0, 100.0),
+            }
+        )
+        super().__init__(self.profiles)
+        self.clock = clock or _time.monotonic
+        self.cost_unit = cost_unit
+        # next-tag state per class
+        self._rtag: dict[str, float] = {}
+        self._wtag: dict[str, float] = {}
+        self._ltag: dict[str, float] = {}
+
+    def _enqueue_weighted(self, klass: str, cost: int, item) -> None:
+        now = self.clock()
+        res, wgt, lim = self.profiles[klass]
+        c = max(float(cost), 1.0) / self.cost_unit
+        c = max(c, 1e-6)
+        rtag = max(
+            now, self._rtag.get(klass, 0.0)
+        ) + (c / res if res > 0 else float("inf"))
+        wtag = max(now, self._wtag.get(klass, 0.0)) + c / max(
+            wgt, 1e-9
+        )
+        ltag = (
+            max(now, self._ltag.get(klass, 0.0)) + c / lim
+            if lim > 0
+            else now
+        )
+        self._rtag[klass] = rtag
+        self._wtag[klass] = wtag
+        self._ltag[klass] = ltag
+        self._queues[klass].append((rtag, wtag, ltag, item))
+
+    def _pick_locked(self):
+        now = self.clock()
+        # 1) reservation phase: any head whose reservation tag is due
+        due = [
+            (q[0][0], k)
+            for k, q in self._queues.items()
+            if q and q[0][0] <= now
+        ]
+        if due:
+            _tag, k = min(due)
+            return self._queues[k].popleft()[3]
+        # 2) weight phase among limit-eligible heads
+        eligible = [
+            (q[0][1], k)
+            for k, q in self._queues.items()
+            if q and q[0][2] <= now
+        ]
+        if eligible:
+            _tag, k = min(eligible)
+            return self._queues[k].popleft()[3]
+        return None
+
+    def dequeue(self, timeout: float | None = None):
+        import time as _time
+
+        # the timeout is wall-clock even under an injected (virtual)
+        # QoS clock — a test clock that never advances must not turn
+        # a bounded dequeue into an infinite loop
+        deadline = (
+            None if timeout is None else _time.monotonic() + timeout
+        )
+        with self._cond:
+            while True:
+                if self._strict:
+                    self._size -= 1
+                    return self._strict.popleft()
+                if self._size > 0:
+                    item = self._pick_locked()
+                    if item is not None:
+                        self._size -= 1
+                        return item
+                    # queued work exists but every head is limited:
+                    # sleep until the earliest tag comes due (or the
+                    # caller's deadline, whichever is first)
+                    next_due = min(
+                        min(q[0][0], q[0][2])
+                        for q in self._queues.values()
+                        if q
+                    )
+                    wait = max(0.001, next_due - self.clock())
+                    if deadline is not None:
+                        remaining = deadline - _time.monotonic()
+                        if remaining <= 0:
+                            raise TimeoutError("queue idle")
+                        wait = min(wait, remaining)
+                    self._cond.wait(wait)
+                    continue
+                if self._draining:
+                    return None
+                remaining = (
+                    None
+                    if deadline is None
+                    else deadline - _time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("queue idle")
+                if not self._cond.wait(remaining):
+                    raise TimeoutError("queue idle")

@@ -171,3 +171,134 @@ def test_osd_client_throttle_bounces_and_client_retries():
         r.shutdown()
     finally:
         c.shutdown()
+
+
+class _VClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_mclock_reservation_floor():
+    """A low-weight class still gets its RESERVED rate while a heavy
+    competitor floods the queue (the dmclock qos floor)."""
+    from ceph_tpu.osd.scheduler import MClockQueue
+
+    clk = _VClock()
+    q = MClockQueue(
+        profiles={
+            CLASS_CLIENT: (10.0, 100.0, 0.0),    # heavy, no floor need
+            CLASS_BACKGROUND: (50.0, 1.0, 0.0),  # tiny weight, 50/s floor
+        },
+        clock=clk,
+        cost_unit=1.0,  # unit costs in this model
+    )
+    for i in range(500):
+        q.enqueue(CLASS_CLIENT, 1, ("client", i))
+    for i in range(100):
+        q.enqueue(CLASS_BACKGROUND, 1, ("background", i))
+    served = {"client": 0, "background": 0}
+    # one simulated second of service
+    for step in range(200):
+        clk.t += 1.0 / 200.0
+        got = q.dequeue(timeout=0.1)
+        served[got[0]] += 1
+    # background's 50/s reservation over 1s => ~50 served despite a
+    # 100:1 weight disadvantage
+    assert served["background"] >= 40, served
+    assert served["client"] >= 100, served
+
+
+def test_mclock_limit_caps_a_class():
+    """A limited class is ineligible past its cap even when the
+    worker is otherwise idle."""
+    from ceph_tpu.osd.scheduler import MClockQueue
+
+    clk = _VClock()
+    q = MClockQueue(
+        profiles={
+            CLASS_CLIENT: (1.0, 10.0, 0.0),
+            CLASS_BACKGROUND: (1.0, 10.0, 10.0),  # hard 10/s cap
+        },
+        clock=clk,
+        cost_unit=1.0,
+    )
+    for i in range(100):
+        q.enqueue(CLASS_BACKGROUND, 1, ("background", i))
+    served = 0
+    for step in range(100):
+        clk.t += 0.01  # one second total
+        try:
+            q.dequeue(timeout=0.0)
+            served += 1
+        except TimeoutError:
+            pass
+    assert served <= 15, served  # ~10/s cap (+reservation slack)
+
+
+def test_mclock_strict_and_drain_sentinel():
+    from ceph_tpu.osd.scheduler import MClockQueue
+
+    q = MClockQueue()
+    q.enqueue(CLASS_CLIENT, 1, "io")
+    q.enqueue(CLASS_STRICT, 0, "peer")
+    q.put(None)
+    assert q.dequeue() == "peer"
+    assert q.dequeue() == "io"
+    assert q.dequeue() is None
+
+
+def test_osd_runs_on_mclock_queue():
+    """Smoke: a live cluster whose OSDs drain the mclock scheduler."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    import test_osd_daemon as tod
+    from ceph_tpu.mon.monitor import Monitor, MonClient
+    from ceph_tpu.msg import Messenger
+    from ceph_tpu.osd.daemon import OSD
+    from ceph_tpu.rados import Rados
+
+    c = tod.MiniCluster.__new__(tod.MiniCluster)
+    c.mon = Monitor(tod._base_map(), min_reporters=2)
+    c.mon_msgr = Messenger("mon")
+    c.mon_msgr.add_dispatcher(c.mon)
+    c.mon_addr = c.mon_msgr.bind()
+    c.osds = {}
+    c.client_msgr = Messenger("client")
+    c.monc = MonClient(c.client_msgr, whoami=-1)
+    c.monc.connect(*c.mon_addr)
+    for i in range(3):
+        osd = OSD(
+            i, tick_interval=0.2, heartbeat_grace=1.0,
+            op_queue="mclock",
+        )
+        osd.boot(*c.mon_addr)
+        c.osds[i] = osd
+    c.wait_active()
+    try:
+        r = Rados("mclock").connect(*c.mon_addr)
+        r.pool_create("mc", pg_num=2, size=2)
+        io = r.open_ioctx("mc")
+        data = {f"m{i}": bytes([i]) * 2000 for i in range(12)}
+        for k, v in data.items():
+            io.write_full(k, v)
+        assert all(io.read(k) == v for k, v in data.items())
+        r.shutdown()
+    finally:
+        c.shutdown()
+
+
+def test_mclock_default_profiles_accept_byte_costs():
+    """Regression (review finding): the daemon enqueues BYTE costs —
+    default profiles must serve a 4096-cost recovery pull promptly,
+    not park it ~20s behind a unit-scale limit tag."""
+    from ceph_tpu.osd.scheduler import MClockQueue
+
+    q = MClockQueue()
+    q.enqueue(CLASS_RECOVERY, 4096, "pull")
+    q.enqueue(CLASS_CLIENT, 64 << 10, "big-write")
+    got = {q.dequeue(timeout=1.0), q.dequeue(timeout=1.0)}
+    assert got == {"pull", "big-write"}
