@@ -28,6 +28,10 @@ from .message import Message, MessageError
 
 BANNER = b"ceph-tpu-msgr/2\n"
 _CALL_TIMEOUT = 30.0
+# largest ciphertext a peer may announce in secure mode; generous vs
+# any legitimate message (multi-MB chunk writes) but far below the
+# 4 GiB the u32 prefix could otherwise demand
+MAX_FRAME_LEN = 1 << 28
 
 
 class Dispatcher:
@@ -81,9 +85,12 @@ class SecureCtx:
         ct = CryptoKey.xor(
             frame, self._send.keystream(ctr8, len(frame))
         )
-        tag = self._send.hmac(ctr8 + ct)
+        clen4 = len(ct).to_bytes(4, "little")
+        # the length prefix is part of the MAC'd material: a tampered
+        # length cannot steer the receiver even before the tag check
+        tag = self._send.hmac(ctr8 + clen4 + ct)
         self.send_ctr += 1
-        return len(ct).to_bytes(4, "little") + ct + tag
+        return clen4 + ct + tag
 
     def unseal(self, ct: bytes, tag: bytes) -> bytes:
         import hmac as hmac_mod
@@ -91,7 +98,9 @@ class SecureCtx:
         from ..auth.cephx import CryptoKey
 
         ctr8 = self.recv_ctr.to_bytes(8, "little")
-        want = self._recv.hmac(ctr8 + ct)
+        want = self._recv.hmac(
+            ctr8 + len(ct).to_bytes(4, "little") + ct
+        )
         if not hmac_mod.compare_digest(tag, want):
             raise MessageError(
                 "secure frame authentication failed (tampered or "
@@ -201,6 +210,16 @@ class Connection:
                     clen = int.from_bytes(
                         await self._reader.readexactly(4), "little"
                     )
+                    # the prefix is plaintext; bound it before
+                    # buffering so a tamperer can't force a multi-GiB
+                    # allocation or an indefinite readexactly hang
+                    # (it is also folded into the MAC, so a forged
+                    # length never yields a valid frame)
+                    if clen > MAX_FRAME_LEN:
+                        raise MessageError(
+                            f"secure frame length {clen} exceeds "
+                            f"{MAX_FRAME_LEN}"
+                        )
                     ct = await self._reader.readexactly(clen)
                     tag = await self._reader.readexactly(32)
                     frame = self.secure.unseal(ct, tag)
@@ -214,6 +233,11 @@ class Connection:
                         Message.HEADER_SIZE
                     )
                     mtype, tid, plen = Message.parse_header(header)
+                    if plen > MAX_FRAME_LEN:
+                        raise MessageError(
+                            f"frame length {plen} exceeds "
+                            f"{MAX_FRAME_LEN}"
+                        )
                     body = await self._reader.readexactly(plen + 4)
                 msg = Message.from_payload(
                     mtype,
@@ -251,6 +275,11 @@ class Connection:
                 fut.set_exception(MessageError("connection reset"))
         try:
             self._writer.close()
+            # wait for connection_lost so the transport is truly dead
+            # before the loop can be closed — an unfinished transport's
+            # __del__ would otherwise call close() on the closed loop
+            # (an unraisable "Event loop is closed" at pytest teardown)
+            await asyncio.wait_for(self._writer.wait_closed(), 1.0)
         except Exception:
             pass
         self.msgr._conn_reset(self)
@@ -445,6 +474,16 @@ class Messenger:
                 self._server.close()
             for conn in list(self._conns):
                 await conn._close()
+            if self._server is not None:
+                # after the conns: on 3.12+ wait_closed blocks until
+                # every connection handler returns, so waiting first
+                # would always eat the full timeout
+                try:
+                    await asyncio.wait_for(
+                        self._server.wait_closed(), 1.0
+                    )
+                except Exception:
+                    pass
             # Cancel anything still in flight on this loop (dials that
             # never completed, lingering read loops) so pytest exits with
             # no "Task was destroyed but it is pending" warnings.

@@ -28,10 +28,13 @@ writes are invisible until ``discard``/``invalidate``.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
 from .objecter import ObjectNotFound, RadosError
+
+log = logging.getLogger(__name__)
 
 
 class _Extent:
@@ -273,16 +276,23 @@ class ObjectCacher:
     def _flush_loop(self) -> None:
         while not self._stop.wait(self.flush_age / 2):
             now = time.monotonic()
-            with self._lock:
-                if self.dirty_bytes > self.target_dirty:
-                    self._flush_some_locked(self.target_dirty)
-                    continue
-                for oid, runs in list(self._objects.items()):
-                    if any(
-                        r.dirty and now - r.born > self.flush_age
-                        for r in runs
-                    ):
-                        self._flush_object_locked(oid)
+            try:
+                with self._lock:
+                    if self.dirty_bytes > self.target_dirty:
+                        self._flush_some_locked(self.target_dirty)
+                        continue
+                    for oid, runs in list(self._objects.items()):
+                        if any(
+                            r.dirty and now - r.born > self.flush_age
+                            for r in runs
+                        ):
+                            self._flush_object_locked(oid)
+            except Exception as e:
+                # a transient backend failure (e.g. an op timing out
+                # across a primary failover) must degrade to a delayed
+                # flush, not kill the flusher thread for the image's
+                # lifetime — dirty runs stay dirty and retry next tick
+                log.warning("object cacher flush tick failed: %s", e)
 
     # -- eviction / invalidation --------------------------------------------
     def _evict_locked(self) -> None:

@@ -312,12 +312,20 @@ class IoCtx:
         return the watch handle (cookie).  The watch lingers: it is
         re-registered on every map change."""
         # cookies must be cluster-unique (the reference keys
-        # watch_info by (entity, cookie)): fold the objecter's
-        # client id in so two clients' first watches cannot collide
-        # on the same persisted record
-        cookie = (
-            (int(self.rados.objecter._client_id, 16) & 0x3FFFFF) << 20
-        ) | next(self.rados._watch_seq)
+        # watch_info by (entity, cookie)): the FULL 48-bit client id
+        # occupies the cookie's high bits — two clients can never
+        # share a persisted w_<cookie> record, so one client's
+        # unwatch cannot erase another's failover record (a truncated
+        # id birthday-collides around ~2k clients).  The low 16 bits
+        # are the per-client sequence (the cookie must fit the u64
+        # MOSDOp.offset wire field); when the sequence wraps past a
+        # still-live older watch we skip forward rather than silently
+        # clobber its callback and persisted record.
+        cid_hi = int(self.rados.objecter._client_id, 16) << 16
+        while True:
+            cookie = cid_hi | (next(self.rados._watch_seq) & 0xFFFF)
+            if cookie not in self.rados._watch_cbs:
+                break
         self.rados._watch_cbs[cookie] = callback
         self.rados.objecter.op_submit(
             self.pool_id, oid, OSD_OP_WATCH, offset=cookie

@@ -141,12 +141,32 @@ class _KVLog:
         )
         e.list(list(dels), lambda e2, k: e2.string(k))
         body = e.getvalue()
-        append_frame(self._wal, body, self.sync)
+        start = self._wal.tell()
+        try:
+            append_frame(self._wal, body, self.sync)
+        except Exception:
+            # a partially-written frame must not poison the WAL: later
+            # commits would land after the torn bytes and be discarded
+            # by replay even though they reported success
+            try:
+                self._wal.truncate(start)
+                self._wal.seek(start)
+            except Exception:
+                pass
+            raise
+        # ---- durable point: nothing below may raise out of commit ----
         self.db.update(sets)
         for k in dels:
             self.db.pop(k, None)
         if self._wal.tell() > 4 << 20:
-            self.compact()
+            try:
+                self.compact()
+            except Exception:
+                # compaction is an optimization; the WAL already holds
+                # the committed frame — a raise here would make the
+                # caller roll back extents that durable onodes
+                # reference (double-allocation corruption)
+                pass
 
     def compact(self) -> None:
         e = Encoder()
@@ -350,38 +370,45 @@ class BlockStore(ObjectStore):
     def queue_transaction(self, txn: Transaction) -> None:
         with self._lock:
             st = _BTxn(self)
+            committed = False
             try:
                 for op in txn.ops:
                     self._apply(st, op)
-            except StoreError:
-                for off, length in st.allocated:
-                    self.alloc.release(off, length)
-                raise
-            # data first ...
-            for off, data in st.dev_writes:
-                self._dev.seek(off)
-                self._dev.write(data)
-            if st.dev_writes:
-                self._dev.flush()
-                if self.sync:
-                    os.fsync(self._dev.fileno())
-            # ... then metadata; a crash in between leaves only
-            # unreferenced bytes in free space
-            sets: dict[str, bytes] = {}
-            dels: list[str] = []
-            for cid in st.new_colls:
-                sets[_ckey(cid)] = b""
-            for cid in st.dead_colls:
-                dels.append(_ckey(cid))
-            dels.extend(st.kv_dels)
-            for (cid, oid), on in st.onodes.items():
-                if on is None:
-                    dels.append(_okey(cid, oid))
-                else:
-                    sets[_okey(cid, oid)] = on.encode()
-            for key, val in st.kv_sets.items():
-                sets[key] = val
-            self.kv.commit(sets, dels)
+                # data first ...
+                for off, data in st.dev_writes:
+                    self._dev.seek(off)
+                    self._dev.write(data)
+                if st.dev_writes:
+                    self._dev.flush()
+                    if self.sync:
+                        os.fsync(self._dev.fileno())
+                # ... then metadata; a crash in between leaves only
+                # unreferenced bytes in free space
+                sets: dict[str, bytes] = {}
+                dels: list[str] = []
+                for cid in st.new_colls:
+                    sets[_ckey(cid)] = b""
+                for cid in st.dead_colls:
+                    dels.append(_ckey(cid))
+                dels.extend(st.kv_dels)
+                for (cid, oid), on in st.onodes.items():
+                    if on is None:
+                        dels.append(_okey(cid, oid))
+                    else:
+                        sets[_okey(cid, oid)] = on.encode()
+                for key, val in st.kv_sets.items():
+                    sets[key] = val
+                self.kv.commit(sets, dels)
+                committed = True
+            finally:
+                if not committed:
+                    # any failure before the KV commit — StoreError
+                    # from an op, ENOSPC from the WAL append, even a
+                    # malformed-tuple TypeError — must hand the fresh
+                    # extents back, or every failed txn leaks space
+                    # until remount
+                    for off, length in st.allocated:
+                        self.alloc.release(off, length)
             for off, length in st.freed:
                 self.alloc.release(off, length)
 
