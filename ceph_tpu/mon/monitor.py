@@ -280,6 +280,51 @@ def _cmd_osd_reweight(mon: Monitor, cmd: dict) -> MMonCommandReply:
     return MMonCommandReply(outb=json.dumps({"epoch": epoch}))
 
 
+def _cmd_osd_blocklist(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """Client fencing ("osd blocklist add/rm/ls", OSDMonitor's
+    blocklist command, src/mon/OSDMonitor.cc prepare_command
+    "osd blocklist").  ``addr`` is the client id the objecter stamps
+    into every reqid; OSDs reject ops from blocklisted ids, which is
+    what makes exclusive-lock break-lock and MDS failover safe."""
+    op = cmd.get("blocklistop", "add")
+    if op == "ls":
+        now = time.time()
+        live = {
+            a: u for a, u in mon.osdmap.blocklist.items() if u > now
+        }
+        return MMonCommandReply(outb=json.dumps(live))
+    addr = cmd["addr"]
+    inc = mon.pending()
+    if op == "add":
+        expire = float(cmd.get("expire", 3600.0))
+        inc.new_blocklist[addr] = time.time() + expire
+        # trim dead entries while we are mutating anyway (the
+        # reference expires them in OSDMonitor tick).  NEVER trim the
+        # addr being re-added: apply_incremental applies new before
+        # old, so the same addr in both would cancel the fresh fence
+        now = time.time()
+        for a, until in mon.osdmap.blocklist.items():
+            if until <= now and a != addr:
+                inc.old_blocklist.append(a)
+        epoch = mon.commit(inc)
+        return MMonCommandReply(
+            outs=f"blocklisting {addr} for {expire}s",
+            outb=json.dumps({"epoch": epoch}),
+        )
+    if op == "rm":
+        if addr not in mon.osdmap.blocklist:
+            return MMonCommandReply(
+                outs=f"{addr} isn't blocklisted"
+            )
+        inc.old_blocklist.append(addr)
+        epoch = mon.commit(inc)
+        return MMonCommandReply(
+            outs=f"un-blocklisting {addr}",
+            outb=json.dumps({"epoch": epoch}),
+        )
+    return MMonCommandReply(rc=-22, outs=f"bad blocklistop {op!r}")
+
+
 def _cmd_pool_create(mon: Monitor, cmd: dict) -> MMonCommandReply:
     """Pool creation (OSDMonitor "osd pool create").  Erasure pools
     (pool_type=3) size themselves from the profile (size=k+m,
@@ -823,6 +868,7 @@ _COMMANDS = {
     "osd out": _cmd_osd_out,
     "osd in": _cmd_osd_in,
     "osd reweight": _cmd_osd_reweight,
+    "osd blocklist": _cmd_osd_blocklist,
     "osd dump": _cmd_osd_dump,
     "osd pool create": _cmd_pool_create,
     "osd pool delete": _cmd_pool_delete,

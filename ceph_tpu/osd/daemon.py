@@ -911,10 +911,27 @@ class OSD(Dispatcher):
                 self.perf.inc("op_w")
             self.perf.tinc("op_latency", time.perf_counter() - t0)
 
+    def _client_blocklisted(self, reqid: str) -> bool:
+        """The reqid's leading field is the objecter's client id —
+        the entity-addr analog the blocklist keys on."""
+        osdmap = self.monc.osdmap
+        if osdmap is None or not osdmap.blocklist:
+            return False
+        return osdmap.is_blocklisted(reqid.rsplit(".", 1)[0])
+
     def _handle_op_inner(self, conn: Connection, msg: MOSDOp) -> None:
         epoch = self.monc.epoch
         pg = self.pgs.get(msg.pgid)
         reply = MOSDOpReply(tid=msg.tid, epoch=epoch)
+        if msg.reqid and self._client_blocklisted(msg.reqid):
+            # fencing (OSDMap::is_blocklisted, OSD.cc op admission):
+            # a blocklisted client gets a hard reject on EVERY op —
+            # this is what makes break-lock and MDS failover safe
+            # against a partitioned-but-alive previous owner
+            reply.ok = False
+            reply.error = "client is blocklisted (-EBLOCKLISTED)"
+            conn.send(reply)
+            return
         if pg is None or pg.primary != self.whoami or pg.state not in (
             "active",
         ):
@@ -1266,6 +1283,15 @@ class OSD(Dispatcher):
         want = set(self._persisted_watchers(pg, oid))
         with self._watch_lock:
             want |= set(self._watchers.get(key, {}))
+        # a blocklisted client's watches are dead to the cluster: its
+        # persisted records neither receive notifies nor hold up the
+        # ack gather (Watch::is_discardable via is_blocklisted)
+        osdmap = self.monc.osdmap
+        if osdmap is not None and osdmap.blocklist:
+            want = {
+                c for c in want
+                if not osdmap.is_blocklisted(f"{c >> 16:012x}")
+            }
         if not want:
             return []
         notify_id = next(self._notify_seq)

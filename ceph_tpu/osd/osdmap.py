@@ -164,6 +164,19 @@ class OSDMap:
         self.pool_max = max(self.pool_max, pool.pool_id)
         return pool
 
+    def is_blocklisted(self, addr: str, now: float | None = None) -> bool:
+        """Client fencing check (OSDMap::is_blocklisted,
+        src/osd/OSDMap.h:585).  ``addr`` is the client's entity
+        address analog — here the objecter's client id.  Entries
+        carry an absolute expiry; expired entries no longer fence
+        (the mon trims them on later commits)."""
+        until = self.blocklist.get(addr)
+        if until is None:
+            return False
+        import time as _time
+
+        return (now if now is not None else _time.time()) < until
+
     def set_max_osd(self, n: int) -> None:
         """Grow (or truncate) every per-OSD vector (OSDMap::set_max_osd).
         New slots exist but are down/out until an incremental boots them."""

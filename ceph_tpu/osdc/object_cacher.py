@@ -32,7 +32,7 @@ import logging
 import threading
 import time
 
-from .objecter import ObjectNotFound, RadosError
+from .objecter import BlocklistedError, ObjectNotFound, RadosError
 
 log = logging.getLogger(__name__)
 
@@ -74,6 +74,7 @@ class ObjectCacher:
         self.misses = 0
         self.backend_writes = 0
         self._stop = threading.Event()
+        self.fatal_error: Exception | None = None
         self._flusher = threading.Thread(
             target=self._flush_loop, name="objectcacher.flush",
             daemon=True,
@@ -208,6 +209,10 @@ class ObjectCacher:
 
     # -- write path ----------------------------------------------------------
     def write(self, oid: str, offset: int, data: bytes) -> None:
+        if self.fatal_error is not None:
+            # fenced: buffering more write-back data would only grow
+            # the amount silently lost — fail fast with the cause
+            raise self.fatal_error
         data = bytes(data)
         if not data:
             return
@@ -267,6 +272,8 @@ class ObjectCacher:
             self._flush_object_locked(oid)
 
     def flush(self, oid: str | None = None) -> None:
+        if self.fatal_error is not None:
+            raise self.fatal_error
         with self._lock:
             if oid is not None:
                 self._flush_object_locked(oid)
@@ -287,6 +294,15 @@ class ObjectCacher:
                             for r in runs
                         ):
                             self._flush_object_locked(oid)
+            except BlocklistedError as e:
+                # FATAL: this client has been fenced — every retry
+                # would fail identically and the application must
+                # learn its write-back data is lost.  Record the
+                # error (surfaced by the next write()/flush()) and
+                # stop the flusher.
+                log.error("object cacher fenced, stopping flusher: %s", e)
+                self.fatal_error = e
+                return
             except Exception as e:
                 # a transient backend failure (e.g. an op timing out
                 # across a primary failover) must degrade to a delayed
@@ -338,4 +354,12 @@ class ObjectCacher:
     def close(self) -> None:
         self._stop.set()
         self._flusher.join(timeout=5)
+        if self.fatal_error is not None:
+            # fenced: the dirty data is unrecoverable from here; the
+            # failure already surfaced (or will) via write()/flush()
+            log.error(
+                "object cacher closed fenced; %d dirty bytes dropped",
+                self.dirty_bytes,
+            )
+            return
         self.flush()

@@ -35,6 +35,13 @@ class ObjectNotFound(ObjecterError):
     pass
 
 
+class BlocklistedError(ObjecterError):
+    """This client has been fenced via the OSDMap blocklist
+    (librados' -EBLOCKLISTED): every op will be rejected until the
+    entry expires or is removed.  Not retried — the fence is the
+    point."""
+
+
 def object_to_pg(pool, oid: str) -> str:
     """pgid string for an object (object_locator_to_pg)."""
     raw_ps = ceph_str_hash_rjenkins(oid)
@@ -151,6 +158,8 @@ class Objecter:
                     continue
                 if "ENOENT" in reply.error or "no object" in reply.error:
                     raise ObjectNotFound(reply.error)
+                if "EBLOCKLISTED" in reply.error:
+                    raise BlocklistedError(reply.error)
                 raise ObjecterError(reply.error)
             except (MessageError, OSError) as e:
                 last_err = str(e)

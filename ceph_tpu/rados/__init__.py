@@ -121,6 +121,23 @@ class Rados:
         reply = self.monc.command(cmd)
         return reply.rc, reply.outb, reply.outs
 
+    @property
+    def client_id(self) -> str:
+        """This client's cluster identity — the entity-addr analog
+        the OSDMap blocklist fences on (rados_get_addrs role)."""
+        return self.objecter._client_id
+
+    def blocklist_add(self, client_id: str, expire: float = 3600.0) -> None:
+        """Fence another client (rados_blocklist_add): every OSD
+        rejects its ops once the map propagates."""
+        reply = self.monc.command({
+            "prefix": "osd blocklist", "blocklistop": "add",
+            "addr": client_id, "expire": expire,
+        })
+        if reply.rc != 0:
+            raise RadosError(reply.outs)
+        self.monc.wait_for_epoch(json.loads(reply.outb)["epoch"])
+
     def open_ioctx(self, pool_name: str) -> "IoCtx":
         return IoCtx(self, self.pool_lookup(pool_name))
 

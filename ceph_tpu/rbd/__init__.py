@@ -30,8 +30,13 @@ import threading
 
 from ..osdc.striper import StripeLayout, map_extent
 from ..osdc.objecter import ObjectNotFound, RadosError
+from .lock import ExclusiveLock, LockBusy
+from .object_map import ObjectMap
 
-__all__ = ["RBD", "Image", "RBDError", "StripeLayout"]
+__all__ = [
+    "RBD", "Image", "RBDError", "StripeLayout", "ExclusiveLock",
+    "LockBusy", "ObjectMap",
+]
 
 DIRECTORY = "rbd_directory"
 _IO_WORKERS = 8
@@ -60,9 +65,18 @@ class RBD:
         stripe_unit: int = 1 << 22,
         stripe_count: int = 1,
         object_size: int = 1 << 22,
+        features: str = "",
     ) -> None:
+        """``features``: comma list of "exclusive-lock" and
+        "object-map" (the RBD_FEATURE_* bits; object-map implies
+        exclusive-lock exactly as the reference enforces)."""
         if size < 0:
             raise RBDError("negative image size")
+        feats = {f for f in features.split(",") if f}
+        if not feats <= {"exclusive-lock", "object-map"}:
+            raise RBDError(f"unknown features {features!r} (-EINVAL)")
+        if "object-map" in feats:
+            feats.add("exclusive-lock")
         layout = StripeLayout(stripe_unit, stripe_count, object_size)
         existing = ioctx.omap_get_vals(DIRECTORY) if self._dir_exists(
             ioctx
@@ -77,6 +91,7 @@ class RBD:
                 "stripe_unit": str(layout.stripe_unit).encode(),
                 "stripe_count": str(layout.stripe_count).encode(),
                 "object_size": str(layout.object_size).encode(),
+                "features": ",".join(sorted(feats)).encode(),
             },
         )
         ioctx.omap_set(DIRECTORY, {name: b"1"})
@@ -165,8 +180,17 @@ class RBD:
                     ioctx.remove(_data_oid(name, objectno))
                 except (ObjectNotFound, RadosError):
                     pass
+            map_oids = [f"rbd_object_map.{name}"] + [
+                f"rbd_object_map.{name}@{sid}"
+                for sid in img._image_snapids()
+            ]
         finally:
             img.close()
+        for moid in map_oids:
+            try:
+                ioctx.remove(moid)
+            except (ObjectNotFound, RadosError):
+                pass
         ioctx.remove(_header_oid(name))
         ioctx.omap_rm_keys(DIRECTORY, [name])
 
@@ -206,6 +230,28 @@ class Image:
             max_workers=_IO_WORKERS,
             thread_name_prefix=f"rbd.{name}",
         )
+        # feature plane: exclusive-lock + object-map (ExclusiveLock /
+        # ObjectMap seats).  Mutations gate on lock ownership; a
+        # cooperative handoff drains in-flight writes, flushes, and
+        # releases (see _handoff_release)
+        self.features = set(
+            meta.get("features", b"").decode().split(",")
+        ) - {""}
+        self._xlock: ExclusiveLock | None = None
+        self._objmap: ObjectMap | None = None
+        self._wr_cond = threading.Condition()
+        self._wr_inflight = 0
+        self._releasing = False
+        if "exclusive-lock" in self.features:
+            self._xlock = ExclusiveLock(
+                ioctx, _header_oid(name),
+                on_release_request=self._handoff_release,
+            )
+        if "object-map" in self.features:
+            self._objmap = ObjectMap(
+                ioctx, f"rbd_object_map.{name}", self._max_objects()
+            )
+            self._objmap.load()
         if cache:
             if self.parent is not None:
                 # the cacher cannot see parent read-through/copy-up;
@@ -220,6 +266,67 @@ class Image:
 
             self._cache = ObjectCacher(ioctx, **(cache_opts or {}))
 
+    # -- exclusive-lock gating ---------------------------------------------
+    def _enter_write(self) -> None:
+        """Every mutation passes here: wait out a handoff in
+        progress, take (or confirm) the exclusive lock, count
+        ourselves in-flight so a handoff can drain us."""
+        if self._xlock is None:
+            return
+        with self._wr_cond:
+            while self._releasing:
+                self._wr_cond.wait()
+            self._wr_inflight += 1
+        try:
+            if not self._xlock.is_owner:
+                self._xlock.acquire()
+                if self._objmap is not None:
+                    # the map is only trusted under the lock: reload
+                    # what the previous owner persisted
+                    self._objmap.load()
+        except BaseException:
+            with self._wr_cond:
+                self._wr_inflight -= 1
+                self._wr_cond.notify_all()
+            raise
+
+    def _exit_write(self) -> None:
+        if self._xlock is None:
+            return
+        with self._wr_cond:
+            self._wr_inflight -= 1
+            self._wr_cond.notify_all()
+
+    def _handoff_release(self) -> None:
+        """Peer asked for the lock: drain in-flight writes, barrier
+        the cache, hand it over (ExclusiveLock's release path)."""
+        with self._wr_cond:
+            self._releasing = True
+            while self._wr_inflight:
+                self._wr_cond.wait()
+            try:
+                if self._cache is not None:
+                    self._cache.flush()
+                self._xlock.release()
+            finally:
+                self._releasing = False
+                self._wr_cond.notify_all()
+
+    def lock_acquire(self) -> None:
+        """Explicitly take the exclusive lock (rbd lock acquire)."""
+        if self._xlock is None:
+            raise RBDError("exclusive-lock feature not enabled")
+        self._xlock.acquire()
+        if self._objmap is not None:
+            self._objmap.load()
+
+    def lock_release(self) -> None:
+        if self._xlock is not None:
+            self._handoff_release()
+
+    def is_lock_owner(self) -> bool:
+        return self._xlock is not None and self._xlock.is_owner
+
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:
         # drain in-flight aio FIRST: a queued aio_write must buffer
@@ -228,6 +335,8 @@ class Image:
         self._pool.shutdown(wait=True)
         if self._cache is not None:
             self._cache.close()  # flush-on-close (rbd_cache contract)
+        if self._xlock is not None:
+            self._xlock.close()
 
     def flush(self) -> None:
         """Barrier all write-back state to the cluster."""
@@ -273,6 +382,13 @@ class Image:
         self.ioctx.omap_set(
             _header_oid(self.name), {"size": str(new_size).encode()}
         )
+        if self._objmap is not None:
+            self._enter_write()
+            try:
+                self._objmap.resize(self._max_objects())
+                self._objmap.save()
+            finally:
+                self._exit_write()
 
     # -- data path ---------------------------------------------------------
     def read(self, offset: int, length: int) -> bytes:
@@ -375,7 +491,17 @@ class Image:
             else:
                 self.ioctx.write(oid, chunk, offset=obj_off)
 
-        list(self._pool.map(write_one, cuts))
+        self._enter_write()
+        try:
+            if self._objmap is not None:
+                # EXISTS lands in the map BEFORE the data ships: a
+                # crash between the two leaves the map conservative
+                self._objmap.pre_write_many(
+                    [c[0] for c in cuts]
+                )
+            list(self._pool.map(write_one, cuts))
+        finally:
+            self._exit_write()
         return len(data)
 
     def discard(self, offset: int, length: int) -> None:
@@ -386,6 +512,13 @@ class Image:
         length = max(0, min(length, self._size - offset))
         if length == 0:
             return
+        self._enter_write()
+        try:
+            self._discard_inner(offset, length)
+        finally:
+            self._exit_write()
+
+    def _discard_inner(self, offset: int, length: int) -> None:
         for objectno, obj_off, n in map_extent(
             self.layout, offset, length
         ):
@@ -399,6 +532,8 @@ class Image:
                 self._copy_up(objectno)
                 self.ioctx.write(oid, b"\0" * n, offset=obj_off)
                 continue
+            if self._objmap is not None and not whole:
+                self._objmap.pre_write(objectno)
             if self._cache is not None and whole:
                 self._cache.discard(oid)
             elif self._cache is not None:
@@ -411,6 +546,10 @@ class Image:
                     self.ioctx.remove(oid)
                 except (ObjectNotFound, RadosError):
                     pass
+                if self._objmap is not None:
+                    # NONEXISTENT lands AFTER the remove commits (the
+                    # inverse of the pre-write order, same reasoning)
+                    self._objmap.post_remove(objectno)
             else:
                 try:
                     self.ioctx.write(oid, b"\0" * n, offset=obj_off)
@@ -429,6 +568,44 @@ class Image:
         self.ioctx.omap_rm_keys(_header_oid(self.name), ["parent"])
         self.parent = None
 
+    # -- object-map queries (rbd diff/du fast path) ------------------------
+    def _image_snapids(self) -> list[int]:
+        """This image's snap ids, oldest first (ids are monotone)."""
+        prefix = f"{self.name}@"
+        return sorted(
+            sid
+            for sid, n in self.ioctx.snap_list().items()
+            if n.startswith(prefix)
+        )
+
+    def diff_objects(self, from_snap: str | None = None) -> list[int]:
+        """Object numbers changed since ``from_snap`` (None = all
+        existing), answered ENTIRELY from the object map — no data
+        object is read or listed (the fast-diff whole-object path,
+        src/librbd/api/DiffIterate.cc).  Requires the object-map
+        feature."""
+        if self._objmap is None:
+            raise RBDError(
+                "diff_objects needs the object-map feature (-EINVAL)"
+            )
+        self._objmap.load()
+        if from_snap is None:
+            return self._objmap.existing_objects()
+        from_id = self.ioctx.snap_lookup(f"{self.name}@{from_snap}")
+        later = tuple(
+            s for s in self._image_snapids() if s > from_id
+        )
+        return self._objmap.diff(from_id, later)
+
+    def used_objects(self) -> int:
+        """Allocated object count from the map (rbd du seat)."""
+        if self._objmap is None:
+            raise RBDError(
+                "used_objects needs the object-map feature (-EINVAL)"
+            )
+        self._objmap.load()
+        return self._objmap.used_objects()
+
     # -- aio (librbd completions) ------------------------------------------
     def aio_read(self, offset: int, length: int):
         return self._pool.submit(self.read, offset, length)
@@ -441,9 +618,33 @@ class Image:
         # completed writes must be IN the snapshot: barrier the
         # write-back cache before taking it (rbd_cache contract)
         self.flush()
-        return self.ioctx.snap_create(f"{self.name}@{snap_name}")
+        snapid = self.ioctx.snap_create(f"{self.name}@{snap_name}")
+        if self._objmap is not None:
+            # freeze the object map at the snap and demote head to
+            # CLEAN — the fast-diff bookkeeping (under the lock: the
+            # map read-modify-write must not race another writer)
+            self._enter_write()
+            try:
+                self._objmap.snap_create(snapid)
+            finally:
+                self._exit_write()
+        return snapid
 
     def snap_remove(self, snap_name: str) -> None:
+        if self._objmap is not None:
+            snapid = self.ioctx.snap_lookup(
+                f"{self.name}@{snap_name}"
+            )
+            later = [
+                s for s in self._image_snapids() if s > snapid
+            ]
+            self._enter_write()
+            try:
+                self._objmap.snap_remove(
+                    snapid, later[0] if later else None
+                )
+            finally:
+                self._exit_write()
         self.ioctx.snap_remove(f"{self.name}@{snap_name}")
 
     def snap_list(self) -> list[str]:

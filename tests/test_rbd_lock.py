@@ -1,0 +1,271 @@
+"""rbd exclusive-lock + object-map over real blocklist fencing
+(src/librbd/ManagedLock.cc, src/librbd/ObjectMap.cc,
+src/osd/OSDMap.h:585 is_blocklisted; VERDICT round-4 ask #2).
+
+The proofs: two concurrent writers serialize through cooperative
+lock handoff; a dead writer is fenced (blocklisted — its ops rejected
+by every OSD) and the survivor proceeds; rbd diff answers from the
+object map without scanning a single data object."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.osdc.objecter import BlocklistedError
+from ceph_tpu.rados import Rados
+from ceph_tpu.rbd import RBD, Image, RBDError
+
+from test_osd_daemon import MiniCluster
+
+POOL = "rbdlock"
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster()
+    for i in range(3):
+        c.start_osd(i)
+    c.wait_active()
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def pool(cluster):
+    r = Rados("rbd-lock-admin").connect(*cluster.mon_addr)
+    r.pool_create(POOL, pg_num=4)
+    try:
+        yield r
+    finally:
+        r.shutdown()
+
+
+def _client(cluster, name):
+    return Rados(name).connect(*cluster.mon_addr)
+
+
+def test_blocklist_fences_client(cluster, pool):
+    a = _client(cluster, "bl-a")
+    b = _client(cluster, "bl-b")
+    try:
+        ioa = a.open_ioctx(POOL)
+        iob = b.open_ioctx(POOL)
+        ioa.write_full("obj", b"from-a")
+        # fence A cluster-wide
+        b.blocklist_add(a.client_id, expire=60.0)
+        # rejection starts the moment each OSD refreshes its map;
+        # poll until the fence takes
+        deadline = time.time() + 10
+        while True:
+            try:
+                ioa.write_full("obj", b"a-again")
+            except BlocklistedError:
+                break
+            assert time.time() < deadline, "fence never took effect"
+            time.sleep(0.1)
+        with pytest.raises(BlocklistedError):
+            ioa.read("obj")
+        # the survivor is untouched
+        iob.write_full("obj", b"from-b")
+        assert iob.read("obj") == b"from-b"
+        # lifting the fence restores service
+        rc, outb, outs = b.mon_command({
+            "prefix": "osd blocklist", "blocklistop": "rm",
+            "addr": a.client_id,
+        })
+        assert rc == 0, outs
+        deadline = time.time() + 10
+        while True:
+            try:
+                assert ioa.read("obj") == b"from-b"
+                break
+            except BlocklistedError:
+                assert time.time() < deadline, "unfence never took"
+                time.sleep(0.1)
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_exclusive_lock_cooperative_handoff(cluster, pool):
+    a = _client(cluster, "xl-a")
+    b = _client(cluster, "xl-b")
+    try:
+        ioa = a.open_ioctx(POOL)
+        iob = b.open_ioctx(POOL)
+        RBD().create(ioa, "ximg", 8 << 20, object_size=1 << 20, stripe_unit=1 << 20,
+                     features="exclusive-lock")
+        img_a = Image(ioa, "ximg")
+        img_b = Image(iob, "ximg")
+        try:
+            img_a.write(0, b"A" * 4096)
+            assert img_a.is_lock_owner()
+            assert not img_b.is_lock_owner()
+            # B's write requests the lock; A hands off cooperatively
+            img_b.write(4096, b"B" * 4096)
+            assert img_b.is_lock_owner()
+            assert not img_a.is_lock_owner()
+            # both writes landed
+            assert img_b.read(0, 4096) == b"A" * 4096
+            assert img_b.read(4096, 4096) == b"B" * 4096
+            # and the lock can travel back
+            img_a.write(8192, b"C" * 16)
+            assert img_a.is_lock_owner()
+            assert not img_b.is_lock_owner()
+        finally:
+            img_a.close()
+            img_b.close()
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_dead_writer_fenced_and_lock_broken(cluster, pool):
+    a = _client(cluster, "dead-a")
+    b = _client(cluster, "dead-b")
+    try:
+        ioa = a.open_ioctx(POOL)
+        iob = b.open_ioctx(POOL)
+        RBD().create(ioa, "dimg", 4 << 20, object_size=1 << 20, stripe_unit=1 << 20,
+                     features="exclusive-lock")
+        img_a = Image(ioa, "dimg")
+        img_b = Image(iob, "dimg")
+        try:
+            img_a.write(0, b"A" * 1024)
+            assert img_a.is_lock_owner()
+            # simulate A dying mid-ownership: its watch vanishes but
+            # its lock record remains (a crashed client looks exactly
+            # like this to the cluster)
+            ioa.unwatch("rbd_header.dimg", img_a._xlock._watch_cookie)
+            img_a._xlock._watch_cookie = None
+            # B requests, gets no ack from the dead owner, fences it
+            # (blocklist) and breaks the stale lock
+            img_b.write(0, b"B" * 1024)
+            assert img_b.is_lock_owner()
+            assert img_b.read(0, 1024) == b"B" * 1024
+            # the fenced half-dead writer CANNOT scribble: every OSD
+            # rejects its ops even though it still believes it owns
+            # the lock
+            assert img_a.is_lock_owner()  # A's stale belief
+            deadline = time.time() + 10
+            with pytest.raises((BlocklistedError, RBDError)):
+                while True:  # poll: fence lands when OSDs refresh
+                    img_a.write(0, b"ZOMBIE!")
+                    assert time.time() < deadline, "never fenced"
+                    time.sleep(0.1)
+            # the survivor's writes stand after the zombie is dead
+            img_b.write(0, b"B" * 1024)
+            assert img_b.read(0, 1024) == b"B" * 1024
+        finally:
+            img_b.close()
+    finally:
+        # A's close path is fenced (unlock would be rejected); drop
+        # the whole client instead of img_a.close()
+        a.shutdown()
+        b.shutdown()
+
+
+def test_object_map_diff_without_scanning(cluster, pool):
+    r = _client(cluster, "om-a")
+    try:
+        io = r.open_ioctx(POOL)
+        RBD().create(io, "mimg", 8 << 20, object_size=1 << 20, stripe_unit=1 << 20,
+                     features="object-map")
+        img = Image(io, "mimg")
+        try:
+            assert "exclusive-lock" in img.features  # implied
+            img.write(0, b"x" * 100)          # object 0
+            img.write(1 << 20, b"y" * 100)    # object 1
+            assert sorted(img.diff_objects()) == [0, 1]
+            assert img.used_objects() == 2
+
+            img.snap_create("s1")
+            # nothing changed since s1 yet
+            assert img.diff_objects("s1") == []
+            img.write(2 << 20, b"z" * 100)    # object 2 after s1
+            assert img.diff_objects("s1") == [2]
+            # rewrite of an existing object also counts
+            img.write(100, b"w" * 8)
+            assert sorted(img.diff_objects("s1")) == [0, 2]
+            # whole-object discard flips existence
+            img.discard(1 << 20, 1 << 20)     # drop object 1
+            assert sorted(img.diff_objects("s1")) == [0, 1, 2]
+            assert sorted(img.diff_objects()) == [0, 2]
+            assert img.used_objects() == 2
+
+            # intermediate-snap correctness: changes between s1 and
+            # s2 must still show in diff-from-s1 after s2 demotes
+            # head states
+            img.snap_create("s2")
+            assert sorted(img.diff_objects("s1")) == [0, 1, 2]
+            assert img.diff_objects("s2") == []
+
+            # ground truth: the map's existence view matches a scan
+            names = set(io.list_objects())
+            for objno in range(img._max_objects()):
+                oid = f"rbd_data.mimg.{objno:016x}"
+                assert (oid in names) == (objno in img.diff_objects())
+        finally:
+            img.close()
+    finally:
+        r.shutdown()
+
+
+def test_snap_remove_folds_interval_dirty_set(cluster, pool):
+    """Removing an intermediate snap must not lose its interval's
+    changes from older-snap diffs (the per-snap map folds into its
+    successor), and the frozen map object must not leak."""
+    r = _client(cluster, "omr-a")
+    try:
+        io = r.open_ioctx(POOL)
+        RBD().create(io, "rimg", 8 << 20, object_size=1 << 20,
+                     stripe_unit=1 << 20, features="object-map")
+        img = Image(io, "rimg")
+        try:
+            img.write(0, b"base")
+            img.snap_create("s1")
+            img.write(3 << 20, b"mid")      # object 3, s1→s2 interval
+            s2_id = img.snap_create("s2")
+            assert img.diff_objects("s1") == [3]
+            # retire s2: object 3's change must STILL show since s1
+            img.snap_remove("s2")
+            assert img.diff_objects("s1") == [3]
+            # and the frozen s2 map object is gone
+            assert f"rbd_object_map.rimg@{s2_id}" not in set(
+                io.list_objects()
+            )
+            # with no later snap, folding lands in head: a fresh
+            # rewrite keeps reporting after the LAST snap goes too
+            img.snap_remove("s1")
+            assert sorted(img.diff_objects()) == [0, 3]
+        finally:
+            img.close()
+    finally:
+        r.shutdown()
+
+
+def test_object_map_travels_with_lock(cluster, pool):
+    a = _client(cluster, "omx-a")
+    b = _client(cluster, "omx-b")
+    try:
+        ioa = a.open_ioctx(POOL)
+        iob = b.open_ioctx(POOL)
+        RBD().create(ioa, "timg", 4 << 20, object_size=1 << 20, stripe_unit=1 << 20,
+                     features="object-map")
+        img_a = Image(ioa, "timg")
+        img_b = Image(iob, "timg")
+        try:
+            img_a.write(0, b"a")            # object 0 via A
+            img_b.write(1 << 20, b"b")      # handoff; object 1 via B
+            assert img_b.is_lock_owner()
+            assert sorted(img_b.diff_objects()) == [0, 1]
+        finally:
+            img_a.close()
+            img_b.close()
+    finally:
+        a.shutdown()
+        b.shutdown()
