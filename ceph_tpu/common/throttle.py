@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import collections
 import threading
+from . import lockdep
 
 
 class Throttle:
@@ -21,7 +22,7 @@ class Throttle:
         self.name = name
         self._max = max_
         self._count = 0
-        self._lock = threading.Lock()
+        self._lock = lockdep.Mutex(f"throttle.{name}")
         # FIFO of (amount, Event) — head wakes first (Throttle.cc's
         # ordered cond list)
         self._waiters: collections.deque = collections.deque()

@@ -41,6 +41,7 @@ import queue
 import threading
 import time
 
+from ..common import lockdep
 from ..msg import Messenger
 from ..msg.message import (
     MClientCaps,
@@ -87,7 +88,7 @@ class MDSDaemon(Dispatcher):
 
         # metadata cache (MDCache role): dirfrags + inodes, loaded
         # lazily from the backing omap, mutated ahead of lazy flushes
-        self._lock = threading.RLock()
+        self._lock = lockdep.RMutex("mds.cache")
         self._dirs: dict[int, dict[str, dict]] = {}
         self._inodes: dict[int, dict] = {}
         self._dirty_dentries: dict[int, dict[str, dict | None]] = {}
@@ -142,6 +143,9 @@ class MDSDaemon(Dispatcher):
                         "name": self.name,
                         "addr": self.addr,
                         "state": self.state,
+                        # the mon fences THIS id if it replaces us
+                        # while we are partitioned (_fence_mds)
+                        "client": self.rados.client_id,
                     }
                 )
                 if rc == 0 and outb:
@@ -152,8 +156,13 @@ class MDSDaemon(Dispatcher):
                         self._become_active()
                     elif want != "active" and self.state == "active":
                         # demoted (mon promoted someone else while we
-                        # were partitioned): stop serving immediately
+                        # were partitioned): stop serving immediately.
+                        # Our old client id is blocklist-fenced — shed
+                        # it for a fresh identity (the reference's
+                        # respawn-with-new-addr) so a LATER promotion
+                        # of this daemon can write again
                         self.state = "standby"
+                        self.rados.objecter.new_identity()
             except Exception:  # noqa: BLE001 — beacons retry forever
                 pass
             self._stop.wait(self.beacon_interval)

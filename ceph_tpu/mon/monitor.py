@@ -672,11 +672,31 @@ def _cmd_config_dump(mon: Monitor, cmd: dict) -> MMonCommandReply:
     return MMonCommandReply(outb=json.dumps(mon.config_db))
 
 
+def _fence_mds(mon: Monitor, entry: dict | None) -> None:
+    """Blocklist a demoted/replaced active's rados client id so a
+    partitioned-but-alive daemon cannot flush journal or metadata the
+    promoted standby's replay never saw (MDSMonitor fences the old
+    gid via the OSDMap blocklist, src/mon/MDSMonitor.cc fail_mds_gid).
+    Paxos-committed, so every OSD enforces it."""
+    cid = (entry or {}).get("client")
+    if not cid:
+        return
+    try:
+        inc = mon.pending()
+        inc.new_blocklist[cid] = time.time() + 3600.0
+        mon.commit(inc)
+    except Exception:  # noqa: BLE001 — a no-quorum window loses the
+        # fence attempt, not the failover; the stale active still
+        # demotes on its next beacon reply
+        pass
+
+
 def _cmd_mds_beacon(mon: Monitor, cmd: dict) -> MMonCommandReply:
     """MDSMonitor beacon handling (src/mon/MDSMonitor.cc reduced):
     one active + standbys, stale-beacon failover.  The mdsmap lives
     on the leader; a fresh leader rebuilds it from the next beacons
-    (deviation: not paxos-committed — documented in mds package)."""
+    (deviation: not paxos-committed — documented in mds package).
+    Replacing a stale active FENCES it (see _fence_mds)."""
     name = cmd["name"]
     addr = cmd["addr"]
     m = getattr(mon, "mdsmap", None)
@@ -687,7 +707,8 @@ def _cmd_mds_beacon(mon: Monitor, cmd: dict) -> MMonCommandReply:
     now = time.time()
     m["beacons"][name] = now
     grace = getattr(mon, "mds_beacon_grace", 4.0)
-    entry = {"name": name, "addr": addr}
+    entry = {"name": name, "addr": addr,
+             "client": cmd.get("client", "")}
     active = m["active"]
     if active is None or active["name"] == name:
         if active is None or active["addr"] != addr:
@@ -697,7 +718,8 @@ def _cmd_mds_beacon(mon: Monitor, cmd: dict) -> MMonCommandReply:
             s for s in m["standbys"] if s["name"] != name
         ]
     elif now - m["beacons"].get(active["name"], 0) > grace:
-        # the active's beacons stopped: promote this daemon
+        # the active's beacons stopped: fence it, promote this daemon
+        _fence_mds(mon, active)
         m["active"] = entry
         m["standbys"] = [
             s for s in m["standbys"] if s["name"] != name
@@ -736,6 +758,7 @@ def _cmd_mds_fail(mon: Monitor, cmd: dict) -> MMonCommandReply:
     if m is None or m["active"] is None:
         return MMonCommandReply(rc=-2, outs="no active mds (-ENOENT)")
     was = m["active"]["name"]
+    _fence_mds(mon, m["active"])
     m["beacons"].pop(was, None)
     if m["standbys"]:
         m["active"] = m["standbys"].pop(0)

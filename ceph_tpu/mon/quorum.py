@@ -69,6 +69,7 @@ from ..msg.messenger import Connection
 from ..osd.osdmap import Incremental, OSDMap
 from ..store.objectstore import StoreError
 from .monitor import MON_COLL, Monitor, MonitorStore
+from ..common import lockdep
 
 STATE_ELECTING = "electing"
 STATE_LEADER = "leader"
@@ -125,7 +126,7 @@ class QuorumMonitor(Monitor):
         self._deferred_to = -1
         self._lease_expiry = 0.0
         self._mon_conns: dict[int, Connection] = {}
-        self._conn_lock = threading.Lock()
+        self._conn_lock = lockdep.Mutex("mon.conn")
         # two queues: _workq carries client work (commands/forwards,
         # which may block up to their RPC timeouts); _electq carries
         # election/paxos coordination (proposals, victories' collect
@@ -136,6 +137,14 @@ class QuorumMonitor(Monitor):
         # deadlock (the OSD daemon's worker-queue rule).
         self._workq: queue.Queue = queue.Queue()
         self._electq: queue.Queue = queue.Queue()
+        # concurrent BEGIN fan-out (commit's pipelined accept gather);
+        # daemon threads so a straggler call never blocks shutdown
+        import concurrent.futures as _cf
+
+        self._paxos_pool = _cf.ThreadPoolExecutor(
+            max_workers=max(4, self.monmap.size),
+            thread_name_prefix=f"mon.{rank}.paxos",
+        )
         self._worker: threading.Thread | None = None
         self._elector: threading.Thread | None = None
         self._ticker: threading.Thread | None = None
@@ -177,6 +186,7 @@ class QuorumMonitor(Monitor):
             self._worker.join(timeout=5)
         if self._elector is not None:
             self._elector.join(timeout=5)
+        self._paxos_pool.shutdown(wait=False)
         self.messenger.shutdown()
 
     @property
@@ -367,8 +377,12 @@ class QuorumMonitor(Monitor):
             version = self.osdmap.epoch + 1
             epoch = self.election_epoch
             peons = sorted(self.quorum - {self.rank})
-            accepts = 1
-            for rank in peons:
+
+            # BEGIN fans out CONCURRENTLY with one shared deadline
+            # (Paxos.cc pipelines begin/accept the same way): a dead
+            # peon costs one timeout total, not one per peon, and the
+            # leader stops waiting the moment a majority accepts
+            def _begin(rank: int) -> bool:
                 try:
                     reply = self._mon_conn(rank).call(
                         MMonPaxos(
@@ -378,9 +392,24 @@ class QuorumMonitor(Monitor):
                         ),
                         timeout=3.0,
                     )
-                    if isinstance(reply, MMonPaxos) and reply.ok:
-                        accepts += 1
+                    return isinstance(reply, MMonPaxos) and reply.ok
                 except (MessageError, OSError):
+                    return False
+
+            accepts = 1
+            if peons:
+                import concurrent.futures as cf
+
+                futs = [
+                    self._paxos_pool.submit(_begin, r) for r in peons
+                ]
+                try:
+                    for f in cf.as_completed(futs, timeout=3.5):
+                        if f.result():
+                            accepts += 1
+                        if accepts >= self.monmap.majority:
+                            break  # stragglers finish on their own
+                except cf.TimeoutError:
                     pass
             if accepts < self.monmap.majority:
                 # lost the quorum mid-round: step down and re-elect

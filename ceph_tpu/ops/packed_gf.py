@@ -200,6 +200,19 @@ def _packed_call(
     return run
 
 
+def prebuilt_word_call(bm: np.ndarray, w: int = 8, *, interpret: bool = False):
+    """Public constructor of the cached word-form kernel for one
+    bitmatrix: returns ``call(*k_word_arrays) -> m_word_arrays``.
+    For callers (benchmarks, device-resident pipelines) that apply
+    the same matrix repeatedly and want to hold the compiled callable
+    rather than re-entering packed_word_regions' conversion layer."""
+    bm = np.asarray(bm)
+    assert supports(bm, w), "packed kernel needs w=8, row popcount <= 255"
+    return _packed_call(
+        _rows_of(bm), bm.shape[1] // 8, bm.shape[0] // 8, interpret
+    )
+
+
 def packed_word_regions(
     bm: np.ndarray, words, *, interpret: bool = False
 ):

@@ -115,6 +115,7 @@ from ..msg.message import (
 from ..msg.messenger import Connection, Dispatcher
 from ..cls import RD as CLS_RD, WR as CLS_WR, ClassError, MethodContext, default_handler
 from ..common.log import dout
+from ..common import lockdep
 from ..mon.monitor import MonClient
 from ..native import ceph_crc32c
 from ..store.ec_store import ECStore, HINFO_KEY
@@ -236,7 +237,7 @@ class OSD(Dispatcher):
             self.messenger, on_map=self._on_map, whoami=whoami
         )
         self.pgs: dict[str, PG] = {}
-        self._pg_lock = threading.RLock()
+        self._pg_lock = lockdep.RMutex("osd.pg")
         # the op worker drains a QoS-classed scheduler, not a FIFO:
         # peering/map events are strict, client ops and background
         # work (scrub, splits) share by weight or by dmclock QoS
@@ -261,7 +262,7 @@ class OSD(Dispatcher):
         self._stop = threading.Event()
         # osd id → (addr, lossless-peer SessionConnection)
         self._conns: dict[int, tuple] = {}
-        self._conn_lock = threading.Lock()
+        self._conn_lock = lockdep.Mutex("osd.conn")
         self.hb = HeartbeatTracker(whoami, grace=heartbeat_grace)
         self.tick_interval = tick_interval
         # EC pool support: cached codecs per profile + a shard-serving
@@ -274,7 +275,7 @@ class OSD(Dispatcher):
         # Objecter linger on every new interval (documented deviation
         # from the reference's object_info-persisted watch records)
         self._watchers: dict[tuple[str, str], dict[int, Connection]] = {}
-        self._watch_lock = threading.Lock()
+        self._watch_lock = lockdep.Mutex("osd.watch")
         self._notify_seq = itertools.count(1)
         self._notify_pending: dict[int, dict] = {}
         # scrub + recovery throttling
@@ -298,7 +299,7 @@ class OSD(Dispatcher):
         self._mgr_conn = None
         self._mgr_addr_checked = 0.0
         self._splitting: set[str] = set()
-        self._recovery_lock = threading.Lock()
+        self._recovery_lock = lockdep.Mutex("osd.recovery")
         self._scrubbing: set[str] = set()
         self.log_keep = 128  # pg_log length bound (osd_min_pg_log_entries role)
         self.class_handler = default_handler  # ClassHandler role

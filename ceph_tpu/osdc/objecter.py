@@ -64,6 +64,15 @@ class Objecter:
         self._lingers: dict[int, tuple[int, str]] = {}  # cookie → (pool, oid)
         self._linger_epoch = 0
 
+    def new_identity(self) -> None:
+        """Adopt a fresh client id (the daemon-respawn analog): a
+        blocklist fence keys on the OLD id, so a fenced daemon that
+        is later re-promoted starts clean — exactly as a respawned
+        reference daemon arrives with a new entity addr.  Watches are
+        cookie-keyed to the old id; callers with live watches must
+        re-register them (the MDS holds none)."""
+        self._client_id = os.urandom(6).hex()
+
     # -- linger (watch re-registration) ------------------------------------
     def linger_register(self, cookie: int, pool_id: int, oid: str):
         self._lingers[cookie] = (pool_id, oid)

@@ -25,6 +25,7 @@ per-image snap contexts.
 from __future__ import annotations
 
 import concurrent.futures
+import contextlib
 import json
 import threading
 
@@ -242,6 +243,13 @@ class Image:
         self._wr_cond = threading.Condition()
         self._wr_inflight = 0
         self._releasing = False
+        # acquire+map-load must complete ATOMICALLY before any other
+        # local writer proceeds: is_owner flips true inside acquire()
+        # BEFORE the map load, and a second writer racing past on
+        # that flag could persist an EXISTS bit the stale load then
+        # clobbers.  _ready flips only after the load.
+        self._acquire_mu = threading.Lock()
+        self._ready = False
         if "exclusive-lock" in self.features:
             self._xlock = ExclusiveLock(
                 ioctx, _header_oid(name),
@@ -267,8 +275,23 @@ class Image:
             self._cache = ObjectCacher(ioctx, **(cache_opts or {}))
 
     # -- exclusive-lock gating ---------------------------------------------
+    def _ensure_owner_ready(self) -> None:
+        """Lock held AND map loaded, atomically vs other local
+        writers (see _acquire_mu/_ready above)."""
+        if self._xlock.is_owner and self._ready:
+            return
+        with self._acquire_mu:
+            if self._xlock.is_owner and self._ready:
+                return
+            self._xlock.acquire()
+            if self._objmap is not None:
+                # the map is only trusted under the lock: reload
+                # what the previous owner persisted
+                self._objmap.load()
+            self._ready = True
+
     def _enter_write(self) -> None:
-        """Every mutation passes here: wait out a handoff in
+        """Every mutation passes here: wait out a handoff/barrier in
         progress, take (or confirm) the exclusive lock, count
         ourselves in-flight so a handoff can drain us."""
         if self._xlock is None:
@@ -278,12 +301,7 @@ class Image:
                 self._wr_cond.wait()
             self._wr_inflight += 1
         try:
-            if not self._xlock.is_owner:
-                self._xlock.acquire()
-                if self._objmap is not None:
-                    # the map is only trusted under the lock: reload
-                    # what the previous owner persisted
-                    self._objmap.load()
+            self._ensure_owner_ready()
         except BaseException:
             with self._wr_cond:
                 self._wr_inflight -= 1
@@ -297,16 +315,42 @@ class Image:
             self._wr_inflight -= 1
             self._wr_cond.notify_all()
 
+    @contextlib.contextmanager
+    def _write_barrier(self):
+        """Exclude ALL writers (local in-flight drained, new ones
+        held at the gate) for an operation that must see a frozen
+        image — the snapshot+map-freeze pair.  A cooperative handoff
+        queues behind the same flag, so the lock cannot leave this
+        client mid-barrier."""
+        if self._xlock is None:
+            yield
+            return
+        with self._wr_cond:
+            while self._releasing:
+                self._wr_cond.wait()
+            self._releasing = True
+            while self._wr_inflight:
+                self._wr_cond.wait()
+        try:
+            yield
+        finally:
+            with self._wr_cond:
+                self._releasing = False
+                self._wr_cond.notify_all()
+
     def _handoff_release(self) -> None:
         """Peer asked for the lock: drain in-flight writes, barrier
         the cache, hand it over (ExclusiveLock's release path)."""
         with self._wr_cond:
+            while self._releasing:
+                self._wr_cond.wait()
             self._releasing = True
             while self._wr_inflight:
                 self._wr_cond.wait()
             try:
                 if self._cache is not None:
                     self._cache.flush()
+                self._ready = False
                 self._xlock.release()
             finally:
                 self._releasing = False
@@ -316,9 +360,7 @@ class Image:
         """Explicitly take the exclusive lock (rbd lock acquire)."""
         if self._xlock is None:
             raise RBDError("exclusive-lock feature not enabled")
-        self._xlock.acquire()
-        if self._objmap is not None:
-            self._objmap.load()
+        self._ensure_owner_ready()
 
     def lock_release(self) -> None:
         if self._xlock is not None:
@@ -615,19 +657,24 @@ class Image:
 
     # -- snapshots (pool-snap delegation; documented deviation) ------------
     def snap_create(self, snap_name: str) -> int:
-        # completed writes must be IN the snapshot: barrier the
-        # write-back cache before taking it (rbd_cache contract)
-        self.flush()
-        snapid = self.ioctx.snap_create(f"{self.name}@{snap_name}")
-        if self._objmap is not None:
-            # freeze the object map at the snap and demote head to
-            # CLEAN — the fast-diff bookkeeping (under the lock: the
-            # map read-modify-write must not race another writer)
-            self._enter_write()
-            try:
+        # the snapshot and the map freeze must see a QUIESCED image:
+        # a write racing between them would have its dirty bit
+        # demoted to CLEAN even though its data lands after the snap,
+        # hiding the object from every future fast-diff.  The barrier
+        # drains in-flight writers and holds new ones (and any lock
+        # handoff) until both land.
+        with self._write_barrier():
+            if self._xlock is not None:
+                self._ensure_owner_ready()
+            # completed writes must be IN the snapshot: barrier the
+            # write-back cache before taking it (rbd_cache contract)
+            if self._cache is not None:
+                self._cache.flush()
+            snapid = self.ioctx.snap_create(
+                f"{self.name}@{snap_name}"
+            )
+            if self._objmap is not None:
                 self._objmap.snap_create(snapid)
-            finally:
-                self._exit_write()
         return snapid
 
     def snap_remove(self, snap_name: str) -> None:
@@ -638,13 +685,11 @@ class Image:
             later = [
                 s for s in self._image_snapids() if s > snapid
             ]
-            self._enter_write()
-            try:
+            with self._write_barrier():
+                self._ensure_owner_ready()
                 self._objmap.snap_remove(
                     snapid, later[0] if later else None
                 )
-            finally:
-                self._exit_write()
         self.ioctx.snap_remove(f"{self.name}@{snap_name}")
 
     def snap_list(self) -> list[str]:

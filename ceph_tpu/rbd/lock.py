@@ -138,7 +138,7 @@ class ExclusiveLock:
                 self._owned = True
                 return
             deadline = time.monotonic() + self.break_timeout
-            owner_alive = False
+            dead_owner: str | None = None
             while time.monotonic() < deadline:
                 self._released.clear()
                 acks = self.ioctx.notify(self.oid, json.dumps(
@@ -154,18 +154,23 @@ class ExclusiveLock:
                 # the lock cookie; an owner that did not ack the
                 # notify is gone (or partitioned) — fence it
                 _oc, _, own_wc = owner.partition(":")
-                owner_alive = any(
+                if not any(
                     a["acked"] and str(a["cookie"]) == own_wc
                     for a in acks
-                )
-                if not owner_alive:
+                ):
+                    dead_owner = owner
                     break
                 self._released.wait(self.request_timeout)
             owner = self._holder()
             if owner is None and self._try_lock():
                 self._owned = True
                 return
-            if owner is None or owner_alive:
+            if owner is None or owner != dead_owner:
+                # either we lost a race to another waiter, or the
+                # holder CHANGED since the liveness test — the cookie
+                # we proved dead is the ONLY one we may fence
+                # (blocklisting whoever holds it now could fence a
+                # live, healthy new owner)
                 raise LockBusy(
                     f"image lock held by live owner {owner!r} (-EBUSY)"
                 )

@@ -48,6 +48,7 @@ import os
 import pathlib
 import threading
 
+from ..common import lockdep
 from ..common.encoding import Decoder, DecodeError, Encoder
 from ..native import ceph_crc32c
 from .framed_log import (
@@ -313,7 +314,7 @@ class BlockStore(ObjectStore):
         self.compressor = compressor_create(compression)
         self._compressor_create = compressor_create
         self.min_compress = min_compress
-        self._lock = threading.RLock()
+        self._lock = lockdep.RMutex("blockstore")
         self.kv = _KVLog(self.path, sync)
         dev_path = self.path / _DEV
         if not dev_path.exists():

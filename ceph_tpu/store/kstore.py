@@ -30,6 +30,7 @@ import os
 import pathlib
 import threading
 
+from ..common import lockdep
 from ..common.encoding import Decoder, DecodeError, Encoder
 from ..native import ceph_crc32c
 from .framed_log import (
@@ -72,7 +73,7 @@ class KStore(MemStore):
 
         self.compressor = compressor_create(compression)
         self.path.mkdir(parents=True, exist_ok=True)
-        self._wal_lock = threading.Lock()
+        self._wal_lock = lockdep.Mutex("kstore.wal")
         self._mount()
         self._wal = open(self.path / _WAL, "ab")
 

@@ -15,6 +15,7 @@ import threading
 from dataclasses import dataclass, field
 
 from ..common.encoding import Decoder, Encoder
+from ..common import lockdep
 
 
 class StoreError(Exception):
@@ -186,7 +187,7 @@ class MemStore(ObjectStore):
     copy-on-write transaction shadows."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.Mutex("memstore")
         self._colls: dict[str, dict[str, _Object]] = {}
 
     # -- transactions ------------------------------------------------------

@@ -23,3 +23,12 @@ os.environ["JAX_PLATFORMS"] = _platform
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", _platform)
+
+# The whole suite runs with lockdep ON (the reference wires lockdep
+# into every ceph::mutex in debug builds, src/common/lockdep.cc): the
+# daemons' named Mutexes register order edges and an ABBA inversion
+# anywhere fails the run.  CEPH_TPU_LOCKDEP=0 opts out.
+if os.environ.get("CEPH_TPU_LOCKDEP", "1") != "0":
+    from ceph_tpu.common import lockdep as _lockdep
+
+    _lockdep.enable()
