@@ -21,8 +21,33 @@ The cap-free library-mode client (dirfrags-in-omap, single writer)
 remains at ceph_tpu.fs.CephFS.
 """
 
-from .journaler import Journaler
-from .server import MDSDaemon
-from .client import MDSClient, MDSError
 
-__all__ = ["Journaler", "MDSDaemon", "MDSClient", "MDSError"]
+def subtree_auth_rank(table: dict, path: str) -> int:
+    """Longest-prefix match of ``path`` against a subtree pin table
+    (the MDCache subtree-auth resolution rule).  SHARED between the
+    MDS server's enforcement and the client's routing: the two ends
+    must agree on this protocol invariant or clients spin on
+    -ESTALE."""
+    parts = [p for p in path.split("/") if p]
+    best, bestlen = 0, -1
+    for pref, r in table.items():
+        pp = [x for x in pref.split("/") if x]
+        if parts[: len(pp)] == pp and len(pp) > bestlen:
+            best, bestlen = r, len(pp)
+    return best
+
+
+def path_dirname(path: str) -> str:
+    """Parent directory of a slash path ('/' for top-level names)."""
+    parts = [p for p in path.split("/") if p]
+    return "/" + "/".join(parts[:-1])
+
+
+from .journaler import Journaler  # noqa: E402
+from .server import MDSDaemon  # noqa: E402
+from .client import MDSClient, MDSError  # noqa: E402
+
+__all__ = [
+    "Journaler", "MDSDaemon", "MDSClient", "MDSError",
+    "subtree_auth_rank", "path_dirname",
+]

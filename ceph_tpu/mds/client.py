@@ -63,8 +63,11 @@ class MDSClient(Dispatcher):
         self.msgr.add_dispatcher(self)
         self.msgr.start()
         self._lock = threading.RLock()
-        self._conn: Connection | None = None
-        self._mds_addr: str | None = None
+        # one session per ACTIVE RANK (multi-MDS): ops route to the
+        # subtree's auth rank by longest-prefix match of the client's
+        # copy of the mon's stable pin table
+        self._conns: dict[int, Connection] = {}
+        self._subtrees: dict[str, int] = {"/": 0}
         # caches valid while the cap stands: ino -> payload, plus the
         # path -> ino tags to invalidate on recall
         self._dir_cache: dict[int, dict] = {}
@@ -80,25 +83,41 @@ class MDSClient(Dispatcher):
     def close(self) -> None:
         self.msgr.shutdown()
 
-    # -- session / failover ------------------------------------------------
-    def _active_mds(self) -> str:
+    # -- session / failover / routing --------------------------------------
+    def _mdsmap(self) -> dict:
         rc, outb, outs = self.rados.mon_command({"prefix": "mds stat"})
         if rc != 0:
             raise MDSError(rc, outs)
-        active = json.loads(outb).get("active")
-        if not active:
+        m = json.loads(outb)
+        if not m.get("actives"):
             raise MDSError(-11, "no active mds (-EAGAIN)")
-        return active["addr"]
+        with self._lock:
+            self._subtrees = dict(m.get("subtrees") or {"/": 0})
+        return m
 
-    def _connect(self) -> None:
-        addr = self._active_mds()
-        host, _, port = addr.rpartition(":")
-        old = self._conn
+    def _auth_rank(self, path: str) -> int:
+        """Longest-prefix match against the stable pin table (the
+        client-side half of subtree delegation: ops go straight to
+        the auth rank)."""
+        from . import subtree_auth_rank
+
+        with self._lock:
+            table = dict(self._subtrees)
+        return subtree_auth_rank(table, path)
+
+    def _connect(self, rank: int = 0) -> Connection:
+        m = self._mdsmap()
+        addr = m["actives"].get(str(rank))
+        if addr is None:
+            raise MDSError(-11, f"no active mds rank {rank} (-EAGAIN)")
+        addr = addr["addr"] if isinstance(addr, dict) else addr
+        old = self._conns.get(rank)
         if old is not None and not old.is_closed:
             try:
                 old.close()
             except (MessageError, OSError):
                 pass
+        host, _, port = addr.rpartition(":")
         conn = self.msgr.connect(host, int(port))
         reply = conn.call(
             MClientRequest(
@@ -110,19 +129,28 @@ class MDSClient(Dispatcher):
         if not isinstance(reply, MClientReply) or reply.rc != 0:
             raise MDSError(-5, "session open failed")
         with self._lock:
-            self._conn = conn
-            self._mds_addr = addr
+            self._conns[rank] = conn
             # a fresh session holds no caps: nothing cached is covered
             self._dir_cache.clear()
             self._stat_cache.clear()
+        return conn
 
-    def _call(self, op: str, args: dict, reqid: str | None = None):
-        """One metadata op with failover retry."""
+    def _call(
+        self,
+        op: str,
+        args: dict,
+        reqid: str | None = None,
+        path: str | None = None,
+    ):
+        """One metadata op with failover retry and subtree routing:
+        a -ESTALE "not auth" reply refreshes the pin table and
+        re-routes (the reference MDS forwards instead)."""
         deadline = time.monotonic() + self.op_timeout
         reqid = reqid or f"{self.name}.{next(self._reqids)}"
         retried = False
+        rank = self._auth_rank(path) if path is not None else 0
         while True:
-            conn = self._conn
+            conn = self._conns.get(rank)
             try:
                 if conn is None or conn.is_closed:
                     raise MessageError("no mds connection")
@@ -136,6 +164,35 @@ class MDSClient(Dispatcher):
                     raise MessageError("bad reply")
                 if reply.rc == -11:  # mds not active: map is moving
                     raise MessageError(reply.outs)
+                if reply.rc == -116:
+                    # not the auth (our table is stale): refresh and
+                    # re-route to the hinted/looked-up rank
+                    if time.monotonic() >= deadline:
+                        raise MDSError(-110, "mds re-route timeout")
+                    try:
+                        self._mdsmap()
+                    except MDSError:
+                        # actives momentarily empty mid-failover:
+                        # keep the retry budget, not a hard error
+                        time.sleep(0.25)
+                        continue
+                    new_rank = (
+                        self._auth_rank(path)
+                        if path is not None
+                        else 0
+                    )
+                    if new_rank == rank:
+                        time.sleep(0.25)  # table still propagating
+                    rank = new_rank
+                    if rank not in self._conns or (
+                        self._conns[rank] is None
+                        or self._conns[rank].is_closed
+                    ):
+                        try:
+                            self._connect(rank)
+                        except (MDSError, MessageError, OSError):
+                            time.sleep(0.25)
+                    continue
                 if reply.rc != 0:
                     if retried:
                         out = self._retry_outcome(op, args, reply)
@@ -148,16 +205,26 @@ class MDSClient(Dispatcher):
                     raise MDSError(-110, f"mds op timeout: {e}")
                 retried = True
                 time.sleep(0.25)
+                if path is not None:
+                    rank = self._auth_rank(path)
                 try:
-                    self._connect()
+                    self._connect(rank)
                 except (MDSError, MessageError, OSError):
                     continue
+
+    @staticmethod
+    def _dirof(path: str) -> str:
+        from . import path_dirname
+
+        return path_dirname(path)
 
     def _retry_outcome(self, op, args, reply) -> dict | None:
         """At-least-once reconciliation after a failover retry: the
         first attempt may have committed before the MDS died."""
         if reply.rc == -17 and op in ("mkdir", "create"):
-            st = self._call("stat", {"path": args["path"]})
+            st = self._call(
+                "stat", {"path": args["path"]}, path=args["path"]
+            )
             want = "dir" if op == "mkdir" else "file"
             if st.get("type") == want:
                 return {"ino": st["ino"]}
@@ -165,7 +232,9 @@ class MDSClient(Dispatcher):
             return {}
         if reply.rc == -2 and op == "rename":
             try:
-                self._call("stat", {"path": args["dst"]})
+                self._call(
+                    "stat", {"path": args["dst"]}, path=args["dst"]
+                )
                 return {}
             except MDSError:
                 pass
@@ -192,10 +261,11 @@ class MDSClient(Dispatcher):
 
     def ms_handle_reset(self, conn: Connection) -> None:
         with self._lock:
-            if conn is self._conn:
-                self._conn = None
-                self._dir_cache.clear()
-                self._stat_cache.clear()
+            for rank, c in list(self._conns.items()):
+                if c is conn:
+                    self._conns.pop(rank, None)
+                    self._dir_cache.clear()
+                    self._stat_cache.clear()
 
     # -- metadata verbs ----------------------------------------------------
     def _local_invalidate(self, *paths: str) -> None:
@@ -214,21 +284,24 @@ class MDSClient(Dispatcher):
                     self._dir_cache.pop(pst["ino"], None)
 
     def mkdir(self, path: str) -> int:
-        out = self._call("mkdir", {"path": path})
+        out = self._call("mkdir", {"path": path},
+                         path=self._dirof(path))
         self._local_invalidate(path)
         return out["ino"]
 
     def rmdir(self, path: str) -> None:
-        self._call("rmdir", {"path": path})
+        self._call("rmdir", {"path": path}, path=self._dirof(path))
         self._local_invalidate(path)
 
     def create(self, path: str) -> int:
-        out = self._call("create", {"path": path})
+        out = self._call("create", {"path": path},
+                         path=self._dirof(path))
         self._local_invalidate(path)
         return out["ino"]
 
     def rename(self, src: str, dst: str) -> None:
-        self._call("rename", {"src": src, "dst": dst})
+        self._call("rename", {"src": src, "dst": dst},
+                   path=self._dirof(src))
         self._local_invalidate(src, dst)
 
     def readdir(self, path: str = "/") -> list[str]:
@@ -238,7 +311,7 @@ class MDSClient(Dispatcher):
                 return sorted(self._dir_cache[st["ino"]])
         with self._lock:
             gen = self._recall_gen
-        out = self._call("readdir", {"path": path})
+        out = self._call("readdir", {"path": path}, path=path)
         with self._lock:
             if self._recall_gen == gen:
                 self._dir_cache[out["ino"]] = out["entries"]
@@ -251,7 +324,7 @@ class MDSClient(Dispatcher):
                 return dict(st)
         with self._lock:
             gen = self._recall_gen
-        out = self._call("stat", {"path": path})
+        out = self._call("stat", {"path": path}, path=path)
         st = {
             "ino": out["ino"],
             "type": out["type"],
@@ -280,7 +353,8 @@ class MDSClient(Dispatcher):
             return st["ino"] if st is not None else -1
 
     def unlink(self, path: str) -> None:
-        out = self._call("unlink", {"path": path})
+        out = self._call("unlink", {"path": path},
+                         path=self._dirof(path))
         self._local_invalidate(path)
         ino = out.get("ino")
         if ino is not None:
@@ -319,6 +393,7 @@ class MDSClient(Dispatcher):
                 },
                 "grow_only": True,
             },
+            path=path,
         )
         with self._lock:
             self._stat_cache.pop(path, None)
@@ -366,7 +441,8 @@ class MDSClient(Dispatcher):
                 except RadosError:
                     pass
         self._call(
-            "setattr", {"path": path, "attrs": {"size": size}}
+            "setattr", {"path": path, "attrs": {"size": size}},
+            path=path,
         )
         with self._lock:
             self._stat_cache.pop(path, None)

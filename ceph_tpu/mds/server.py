@@ -25,13 +25,27 @@ Shape of the reference this mirrors:
   active's beacons stop, and the promoted daemon replays the journal
   before serving.
 
-Deviations (documented): single active MDS (no subtree delegation /
-Migrator), caps are per-inode read-caching only (no cap bits
-spectrum, no file-data leases — file DATA goes client→rados
-directly), sessions/caps are in-memory (clients re-open sessions
-after failover, as in the reference's reconnect phase), and a
-demoted active stops serving on its next beacon reply rather than
-being blocklist-fenced.
+- **Multi-MDS subtree delegation** (MDCache subtree auth,
+  src/mds/MDCache.cc:1, + Migrator export, src/mds/Migrator.cc:1,
+  reduced): up to max_mds actives, each auth for the pinned subtrees
+  the mon's table assigns it (longest-prefix match), each journaling
+  its own rank's mutations.  Because metadata lives in shared rados
+  omap objects and caches load lazily, EXPORT is a flush + table
+  flip + cap revoke (with a mon-side barrier: clients only see a
+  table every active has flushed under) instead of a cache-streaming
+  state machine.  Cross-subtree renames run as an MDS→MDS
+  ``peer_link`` sub-op (the slave-request seat) followed by the
+  local unlink.  A demoted/replaced active is blocklist-fenced via
+  its beacon-carried client id.
+
+Deviations (documented): caps are per-inode read-caching only (no
+cap bits spectrum, no file-data leases — file DATA goes
+client→rados directly), sessions/caps are in-memory (clients
+re-open sessions after failover, as in the reference's reconnect
+phase), cap coherence is per-rank (a mutation revokes only the auth
+rank's sessions; cross-rank readers of boundary dirfrags read fresh
+instead), and a crashed cross-rename can leave the name briefly
+visible in both directories (link-then-unlink order — never lost).
 """
 
 from __future__ import annotations
@@ -85,6 +99,15 @@ class MDSDaemon(Dispatcher):
         self.beacon_interval = beacon_interval
         self.state = "standby"
         self.mdsmap_epoch = 0
+        # multi-MDS (subtree delegation, MDCache subtree auth +
+        # Migrator reduced): my rank, the subtree auth table, and the
+        # peer actives' addresses — all distributed via beacons
+        self.rank = -1
+        self.ops_served = 0  # observability: which actives take traffic
+        self._subtrees: dict[str, int] = {"/": 0}
+        self._applied_table_epoch = 0
+        self._peer_addrs: dict[int, str] = {}
+        self._peer_conns: dict[int, Connection] = {}
 
         # metadata cache (MDCache role): dirfrags + inodes, loaded
         # lazily from the backing omap, mutated ahead of lazy flushes
@@ -146,14 +169,42 @@ class MDSDaemon(Dispatcher):
                         # the mon fences THIS id if it replaces us
                         # while we are partitioned (_fence_mds)
                         "client": self.rados.client_id,
+                        # ack: the subtree table epoch we have
+                        # FLUSHED under (the export barrier)
+                        "table_epoch": self._applied_table_epoch,
                     }
                 )
                 if rc == 0 and outb:
                     told = json.loads(outb)
                     self.mdsmap_epoch = told.get("epoch", 0)
                     want = told.get("state", "standby")
-                    if want == "active" and self.state != "active":
-                        self._become_active()
+                    self._peer_addrs = {
+                        int(r): a
+                        for r, a in told.get("actives", {}).items()
+                    }
+                    new_table = told.get("subtrees")
+                    new_te = told.get("table_epoch", 0)
+                    new_rank = told.get("rank", 0)
+                    if want == "active" and (
+                        self.state != "active"
+                        or new_rank != self.rank
+                    ):
+                        # fresh promotion OR a mon-side rank
+                        # reassignment (e.g. set-max-mds reshuffle):
+                        # flush the old rank's state, then take over
+                        # the new rank's journal
+                        if self.state == "active":
+                            with self._lock:
+                                self._flush()
+                        self._subtrees = dict(new_table or {"/": 0})
+                        self._applied_table_epoch = new_te
+                        self._become_active(new_rank)
+                    elif (
+                        want == "active"
+                        and new_table is not None
+                        and new_te > self._applied_table_epoch
+                    ):
+                        self._apply_subtree_table(new_table, new_te)
                     elif want != "active" and self.state == "active":
                         # demoted (mon promoted someone else while we
                         # were partitioned): stop serving immediately.
@@ -167,10 +218,21 @@ class MDSDaemon(Dispatcher):
                 pass
             self._stop.wait(self.beacon_interval)
 
-    def _become_active(self) -> None:
-        """Standby takeover: replay the journal tail into the cache
-        (the up:replay → up:active walk), then serve."""
+    def _become_active(self, rank: int = 0) -> None:
+        """Standby takeover of a RANK: replay that rank's journal
+        tail into the cache (the up:replay → up:active walk), then
+        serve.  Each rank journals independently (MDLog is per-rank
+        in the reference too), so replay rebuilds exactly the dead
+        rank's unflushed mutations."""
         with self._lock:
+            self.rank = rank
+            self.journal = Journaler(
+                self.meta,
+                prefix=(
+                    "mds_journal" if rank == 0
+                    else f"mds_journal.{rank}"
+                ),
+            )
             self._dirs.clear()
             self._inodes.clear()
             self._dirty_dentries.clear()
@@ -186,6 +248,25 @@ class MDSDaemon(Dispatcher):
             self._load_next_ino()
             self.state = "active"
 
+    def _apply_subtree_table(self, table: dict, te: int) -> None:
+        """Subtree table changed (a pin moved authority): flush ALL
+        dirty state to the backing omap, drop the cache, and revoke
+        every cap — the export/import handoff reduced to its
+        essentials (the new auth loads lazily from the same backing
+        objects, so migration IS the flush + table flip; the
+        reference's Migrator streams cache state instead —
+        deviation documented in the module docstring).  Only after
+        this does the next beacon ack ``te``, which is what lets the
+        mon expose the new table to clients."""
+        with self._lock:
+            self._flush()
+            self._dirs.clear()
+            self._inodes.clear()
+            for ino in list(self._cap_holders):
+                self._revoke(ino, None)
+            self._subtrees = dict(table)
+            self._applied_table_epoch = te
+
     # -- backing store (the ceph_tpu.fs omap layout) -----------------------
     def _mkfs_if_needed(self) -> None:
         from ..osdc.objecter import ObjectNotFound, RadosError
@@ -200,17 +281,40 @@ class MDSDaemon(Dispatcher):
             )
             self.meta.write_full(_dir_oid(ROOT_INO), b"")
 
+    # -- per-rank ino space ------------------------------------------------
+    # ranks allocate from disjoint ranges (rank << 40 | counter) so
+    # two actives never collide (the reference partitions via
+    # per-rank inotable, src/mds/InoTable.cc); rank 0 keeps the
+    # legacy low range.
+    def _ino_key(self) -> str:
+        return (
+            "next_ino" if self.rank <= 0 else f"next_ino.{self.rank}"
+        )
+
+    def _ino_base(self) -> int:
+        return 2 if self.rank <= 0 else (self.rank << 40) + 2
+
+    def _my_ino(self, ino: int) -> bool:
+        return (ino >> 40) == max(self.rank, 0)
+
     def _load_next_ino(self) -> None:
         stored = int(
-            self._ino_meta(ROOT_INO).get("next_ino", 2)
+            self._ino_meta(ROOT_INO).get(
+                self._ino_key(), self._ino_base()
+            )
         )
         # journal replay may carry allocations past the flushed value
         highest = max(
             [stored - 1]
-            + list(self._inodes)
-            + [d["ino"] for frag in self._dirs.values() for d in frag.values()]
+            + [i for i in self._inodes if self._my_ino(i)]
+            + [
+                d["ino"]
+                for frag in self._dirs.values()
+                for d in frag.values()
+                if self._my_ino(d["ino"])
+            ]
         )
-        self._next_ino = highest + 1
+        self._next_ino = max(highest + 1, self._ino_base())
 
     def _load_dir(self, ino: int) -> dict[str, dict]:
         from ..osdc.objecter import ObjectNotFound, RadosError
@@ -237,7 +341,9 @@ class MDSDaemon(Dispatcher):
             for k, v in vals.items():
                 v = v.decode()
                 meta[k] = (
-                    int(v) if k in ("size", "next_ino") else v
+                    int(v)
+                    if k in ("size",) or k.startswith("next_ino")
+                    else v
                 )
             self._inodes[ino] = meta
         return self._inodes[ino]
@@ -283,7 +389,7 @@ class MDSDaemon(Dispatcher):
                     pass
         self.meta.omap_set(
             _ino_oid(ROOT_INO),
-            {"next_ino": str(self._next_ino).encode()},
+            {self._ino_key(): str(self._next_ino).encode()},
         )
         self._dirty_dentries.clear()
         self._dirty_inodes.clear()
@@ -328,6 +434,27 @@ class MDSDaemon(Dispatcher):
             self._mark_dentry(sp, sn, None)
             self._load_dir_or_empty(dp)[dn] = dentry
             self._mark_dentry(dp, dn, dentry)
+        elif op == "rename_out":
+            # OUR half of a cross-rank rename: the dentry leaves
+            # this rank's subtree (the peer journals the insert).
+            # Drop the cached inode meta too — the new auth owns it
+            # now, and a later rename BACK must reload its (possibly
+            # mutated) meta from the backing omap, not trust ours.
+            parent, name = ent["parent"], ent["name"]
+            frag = self._load_dir_or_empty(parent)
+            gone = frag.pop(name, None)
+            self._mark_dentry(parent, name, None)
+            if gone is not None:
+                self._inodes.pop(gone["ino"], None)
+                self._dirty_inodes.discard(gone["ino"])
+        elif op == "rename_in":
+            parent, name = ent["parent"], ent["name"]
+            dentry = ent["dentry"]
+            self._load_dir_or_empty(parent)[name] = dentry
+            self._mark_dentry(parent, name, dentry)
+            # force a lazy reload of the arriving inode's meta (the
+            # old auth flushed it before the peer_link)
+            self._inodes.pop(dentry["ino"], None)
         elif op == "setattr":
             ino = ent["ino"]
             try:
@@ -348,22 +475,79 @@ class MDSDaemon(Dispatcher):
     def _mark_dentry(self, dir_ino, name, dentry) -> None:
         self._dirty_dentries.setdefault(dir_ino, {})[name] = dentry
 
-    def _journal_and_apply(self, ent: dict) -> None:
+    def _journal_and_apply(
+        self, ent: dict, force_flush: bool = False
+    ) -> None:
         self.journal.append(json.dumps(ent).encode())
         self.journal.flush()
         self._apply_entry(ent)
         self._unflushed += 1
-        if self._unflushed >= self.flush_every:
+        if force_flush or self._unflushed >= self.flush_every:
             self._flush()
+
+    # -- subtree authority (MDCache subtree auth, reduced) -----------------
+    def _auth_rank(self, path: str) -> int:
+        from . import subtree_auth_rank
+
+        return subtree_auth_rank(self._subtrees, path)
+
+    def _check_auth(self, path: str) -> None:
+        r = self._auth_rank(path)
+        if r != self.rank:
+            # the client re-routes from the hinted rank (the
+            # reference's MDS would forward the request itself;
+            # client-side re-dispatch is the reduction)
+            raise _Err(
+                -116,
+                f"not auth for {path!r}; mds rank {r} is (-ESTALE "
+                f"auth={r})",
+            )
+
+    def _is_boundary(self, dir_path: str) -> bool:
+        """A dirfrag some pin path passes THROUGH: its dentries are
+        walked by other ranks, so mutations flush immediately (other
+        ranks read boundary frags fresh from the backing omap — see
+        _walk).  Non-boundary frags keep the lazy-flush + journal
+        discipline."""
+        parts = [p for p in dir_path.split("/") if p]
+        for pref in self._subtrees:
+            pp = [x for x in pref.split("/") if x]
+            if len(pp) > len(parts) and pp[: len(parts)] == parts:
+                return True
+        return False
+
+    @staticmethod
+    def _dirname(path: str) -> str:
+        from . import path_dirname
+
+        return path_dirname(path)
+
+    def _read_dir_fresh(self, ino: int) -> dict[str, dict]:
+        """Uncached read of a FOREIGN dirfrag: another rank owns (and
+        may be mutating) it; caching would go stale with no recall
+        path.  Boundary frags flush-on-mutate at their auth, so this
+        read is coherent up to the op in flight."""
+        from ..osdc.objecter import ObjectNotFound, RadosError
+
+        try:
+            vals = self.meta.omap_get_vals(_dir_oid(ino))
+        except (ObjectNotFound, RadosError):
+            return {}
+        return {k: json.loads(v) for k, v in vals.items()}
 
     # -- path walking ------------------------------------------------------
     def _walk(self, path: str) -> tuple[int, dict]:
+        parts = [p for p in path.split("/") if p]
         ino = ROOT_INO
         dentry = {"type": "dir", "ino": ROOT_INO}
-        for name in [p for p in path.split("/") if p]:
+        for i, name in enumerate(parts):
             if dentry["type"] != "dir":
                 raise _Err(-20, f"{name!r}: not a directory (-ENOTDIR)")
-            frag = self._load_dir_or_empty(ino)
+            prefix = "/" + "/".join(parts[:i])
+            if self._auth_rank(prefix) == self.rank:
+                frag = self._load_dir_or_empty(ino)
+            else:
+                frag = self._read_dir_fresh(ino)
             if name not in frag:
                 raise _Err(-2, f"{path!r} (-ENOENT)")
             dentry = frag[name]
@@ -453,6 +637,13 @@ class MDSDaemon(Dispatcher):
                 elif self.state != "active":
                     reply.rc = -11
                     reply.outs = "mds not active (-EAGAIN)"
+                elif msg.op.startswith("peer_"):
+                    # MDS→MDS sub-op (the slave-request seat): no
+                    # client session — the caller is a peer rank
+                    outb = self._handle_peer(
+                        msg.op, json.loads(msg.args)
+                    )
+                    reply.outb = json.dumps(outb)
                 else:
                     sess = self._sessions.get(conn)
                     if sess is None:
@@ -493,7 +684,9 @@ class MDSDaemon(Dispatcher):
 
     # -- ops (Server.cc handle_client_* reduced) ---------------------------
     def _handle_op(self, sess: _Session, op: str, args: dict) -> dict:
+        self.ops_served += 1
         if op == "mkdir":
+            self._check_auth(self._dirname(args["path"]))
             parent, name = self._parent_of(args["path"])
             if name in self._load_dir_or_empty(parent):
                 raise _Err(-17, f"{args['path']!r} exists (-EEXIST)")
@@ -504,10 +697,14 @@ class MDSDaemon(Dispatcher):
                 {
                     "op": "mkdir", "parent": parent, "name": name,
                     "ino": ino, "mtime": time.time(),
-                }
+                },
+                force_flush=self._is_boundary(
+                    self._dirname(args["path"])
+                ),
             )
             return {"ino": ino}
         if op == "create":
+            self._check_auth(self._dirname(args["path"]))
             parent, name = self._parent_of(args["path"])
             if name in self._load_dir_or_empty(parent):
                 raise _Err(-17, f"{args['path']!r} exists (-EEXIST)")
@@ -518,10 +715,14 @@ class MDSDaemon(Dispatcher):
                 {
                     "op": "create", "parent": parent, "name": name,
                     "ino": ino, "mtime": time.time(),
-                }
+                },
+                force_flush=self._is_boundary(
+                    self._dirname(args["path"])
+                ),
             )
             return {"ino": ino}
         if op == "rmdir":
+            self._check_auth(self._dirname(args["path"]))
             parent, name = self._parent_of(args["path"])
             frag = self._load_dir_or_empty(parent)
             if name not in frag:
@@ -537,10 +738,14 @@ class MDSDaemon(Dispatcher):
                 {
                     "op": "rmdir", "parent": parent, "name": name,
                     "ino": dentry["ino"],
-                }
+                },
+                force_flush=self._is_boundary(
+                    self._dirname(args["path"])
+                ),
             )
             return {}
         if op == "unlink":
+            self._check_auth(self._dirname(args["path"]))
             parent, name = self._parent_of(args["path"])
             frag = self._load_dir_or_empty(parent)
             if name not in frag:
@@ -554,15 +759,42 @@ class MDSDaemon(Dispatcher):
                 {
                     "op": "unlink", "parent": parent, "name": name,
                     "ino": dentry["ino"],
-                }
+                },
+                force_flush=self._is_boundary(
+                    self._dirname(args["path"])
+                ),
             )
             return {"ino": dentry["ino"]}
         if op == "rename":
+            src_dir = self._dirname(args["src"])
+            dst_dir = self._dirname(args["dst"])
+            self._check_auth(src_dir)
             sp, sn = self._parent_of(args["src"])
-            dp, dn = self._parent_of(args["dst"])
             sfrag = self._load_dir_or_empty(sp)
             if sn not in sfrag:
                 raise _Err(-2, f"{args['src']!r} (-ENOENT)")
+            dst_rank = self._auth_rank(dst_dir)
+            if dst_rank != self.rank:
+                # cross-subtree rename: the dst auth journals the
+                # link (our "slave request", Migrator/Server
+                # rename-across-auth reduced to link-then-unlink; a
+                # crash between the two leaves the name visible in
+                # BOTH places — never lost).  Flush FIRST: the moved
+                # inode's dirty meta (size/mtime) must reach the
+                # backing omap before the new auth loads it lazily —
+                # the same export barrier a pin flip uses.
+                self._flush()
+                self._peer_call(
+                    dst_rank, "peer_link",
+                    {"dst": args["dst"], "dentry": sfrag[sn]},
+                )
+                self._revoke(sp, sess)
+                self._journal_and_apply(
+                    {"op": "rename_out", "parent": sp, "name": sn},
+                    force_flush=self._is_boundary(src_dir),
+                )
+                return {}
+            dp, dn = self._parent_of(args["dst"])
             if dn in self._load_dir_or_empty(dp):
                 raise _Err(-17, f"{args['dst']!r} exists (-EEXIST)")
             self._revoke(sp, sess)
@@ -572,10 +804,15 @@ class MDSDaemon(Dispatcher):
                     "op": "rename", "sparent": sp, "sname": sn,
                     "dparent": dp, "dname": dn,
                     "dentry": sfrag[sn],
-                }
+                },
+                force_flush=(
+                    self._is_boundary(src_dir)
+                    or self._is_boundary(dst_dir)
+                ),
             )
             return {}
         if op == "readdir":
+            self._check_auth(args["path"])
             ino, dentry = self._walk(args["path"])
             if dentry["type"] != "dir":
                 raise _Err(-20, "not a directory (-ENOTDIR)")
@@ -585,6 +822,7 @@ class MDSDaemon(Dispatcher):
                 "entries": self._load_dir_or_empty(ino),
             }
         if op == "stat":
+            self._check_auth(args["path"])
             ino, dentry = self._walk(args["path"])
             try:
                 meta = self._ino_meta(ino)
@@ -598,6 +836,7 @@ class MDSDaemon(Dispatcher):
                 "mtime": float(meta.get("mtime", 0)),
             }
         if op == "setattr":
+            self._check_auth(args["path"])
             ino, dentry = self._walk(args["path"])
             attrs = dict(args["attrs"])
             if args.get("grow_only") and "size" in attrs:
@@ -612,6 +851,60 @@ class MDSDaemon(Dispatcher):
             )
             return {"ino": ino, "size": attrs.get("size")}
         raise _Err(-22, f"unknown op {op!r} (-EINVAL)")
+
+    # -- MDS-to-MDS sub-ops (slave requests, reduced) ----------------------
+    def _peer_call(self, rank: int, op: str, args: dict) -> dict:
+        """Blocking sub-op on a peer active.  Runs on the worker
+        thread (never the messenger loop — connect/call would
+        deadlock there).  A timeout surfaces as -EAGAIN so the
+        client retries the whole op; two opposite-direction
+        cross-renames can in principle wait on each other's worker,
+        and the timeout is what unwinds that (the reference orders
+        slave requests by MDRequest instead)."""
+        addr = self._peer_addrs.get(rank)
+        if addr is None:
+            raise _Err(-11, f"no active mds rank {rank} (-EAGAIN)")
+        try:
+            conn = self._peer_conns.get(rank)
+            if conn is None or conn.is_closed:
+                host, _, port = addr.rpartition(":")
+                conn = self.msgr.connect(host, int(port))
+                self._peer_conns[rank] = conn
+            from ..msg.message import MClientRequest as _Req
+
+            reply = conn.call(
+                _Req(op=op, args=json.dumps(args)), timeout=5.0
+            )
+        except (MessageError, OSError) as e:
+            self._peer_conns.pop(rank, None)
+            raise _Err(-11, f"peer rank {rank} unreachable: {e} (-EAGAIN)")
+        if reply.rc != 0:
+            raise _Err(reply.rc, reply.outs)
+        return json.loads(reply.outb) if reply.outb else {}
+
+    def _handle_peer(self, op: str, args: dict) -> dict:
+        if op == "peer_link":
+            dst = args["dst"]
+            self._check_auth(self._dirname(dst))
+            dp, dn = self._parent_of(dst)
+            existing = self._load_dir_or_empty(dp).get(dn)
+            if existing is not None:
+                if existing.get("ino") == args["dentry"].get("ino"):
+                    # retried cross-rename whose first attempt
+                    # already linked here: idempotent success (the
+                    # ack was lost, not the commit)
+                    return {}
+                raise _Err(-17, f"{dst!r} exists (-EEXIST)")
+            self._revoke(dp, None)
+            self._journal_and_apply(
+                {
+                    "op": "rename_in", "parent": dp, "name": dn,
+                    "dentry": args["dentry"],
+                },
+                force_flush=self._is_boundary(self._dirname(dst)),
+            )
+            return {}
+        raise _Err(-22, f"unknown peer op {op!r} (-EINVAL)")
 
 
 class _Err(Exception):

@@ -691,82 +691,226 @@ def _fence_mds(mon: Monitor, entry: dict | None) -> None:
         pass
 
 
+def _mdsmap_of(mon: Monitor) -> dict:
+    m = getattr(mon, "mdsmap", None)
+    if m is None or "actives" not in m:
+        m = mon.mdsmap = {
+            "epoch": 0,
+            "max_mds": 1,
+            # rank (as str, JSON-stable) -> {name, addr, client}
+            "actives": {},
+            "standbys": [],
+            "beacons": {},
+            # subtree auth table: path prefix -> rank.  "subtrees" is
+            # the LATEST table (what daemons must converge to);
+            # "subtrees_stable" is what clients may route by — it
+            # advances only once every active has flushed under the
+            # new table and acked its epoch (the Migrator
+            # export/import barrier, reduced to flush+ack)
+            "subtrees": {"/": 0},
+            "subtrees_stable": {"/": 0},
+            "table_epoch": 0,
+            "table_acks": {},  # name -> acked table_epoch
+        }
+    return m
+
+
+def _mds_promote_holes(mon: Monitor, m: dict) -> None:
+    """Fill empty ranks (0..max_mds-1) from the standby pool."""
+    for rank in range(m["max_mds"]):
+        key = str(rank)
+        if key in m["actives"]:
+            continue
+        if not m["standbys"]:
+            break
+        m["actives"][key] = m["standbys"].pop(0)
+        m["epoch"] += 1
+
+
+def _mds_table_maybe_stabilize(m: dict) -> None:
+    """Expose the latest subtree table to clients once EVERY active
+    has flushed under it (two-phase export: the old auth's dirty
+    state must reach the backing omap before the new auth serves)."""
+    te = m["table_epoch"]
+    if m["subtrees_stable"] == m["subtrees"]:
+        return
+    if all(
+        m["table_acks"].get(e["name"], -1) >= te
+        for e in m["actives"].values()
+    ):
+        m["subtrees_stable"] = dict(m["subtrees"])
+
+
 def _cmd_mds_beacon(mon: Monitor, cmd: dict) -> MMonCommandReply:
     """MDSMonitor beacon handling (src/mon/MDSMonitor.cc reduced):
-    one active + standbys, stale-beacon failover.  The mdsmap lives
-    on the leader; a fresh leader rebuilds it from the next beacons
-    (deviation: not paxos-committed — documented in mds package).
-    Replacing a stale active FENCES it (see _fence_mds)."""
+    max_mds active ranks + standbys, stale-beacon failover, subtree
+    table distribution.  The mdsmap lives on the leader; a fresh
+    leader rebuilds it from the next beacons (deviation: not
+    paxos-committed — documented in mds package).  Replacing a stale
+    active FENCES it (see _fence_mds)."""
     name = cmd["name"]
     addr = cmd["addr"]
-    m = getattr(mon, "mdsmap", None)
-    if m is None:
-        m = mon.mdsmap = {
-            "epoch": 0, "active": None, "standbys": [], "beacons": {},
-        }
+    m = _mdsmap_of(mon)
     now = time.time()
     m["beacons"][name] = now
+    if "table_epoch" in cmd:
+        m["table_acks"][name] = int(cmd["table_epoch"])
+        _mds_table_maybe_stabilize(m)
     grace = getattr(mon, "mds_beacon_grace", 4.0)
     entry = {"name": name, "addr": addr,
              "client": cmd.get("client", "")}
-    active = m["active"]
-    if active is None or active["name"] == name:
-        if active is None or active["addr"] != addr:
+
+    # evict stale actives (fenced) so their ranks become holes
+    for rank, e in list(m["actives"].items()):
+        if (
+            e["name"] != name
+            and now - m["beacons"].get(e["name"], 0) > grace
+        ):
+            _fence_mds(mon, e)
+            del m["actives"][rank]
+            m["table_acks"].pop(e["name"], None)
             m["epoch"] += 1
-        m["active"] = entry
-        m["standbys"] = [
-            s for s in m["standbys"] if s["name"] != name
-        ]
-    elif now - m["beacons"].get(active["name"], 0) > grace:
-        # the active's beacons stopped: fence it, promote this daemon
-        _fence_mds(mon, active)
-        m["active"] = entry
-        m["standbys"] = [
-            s for s in m["standbys"] if s["name"] != name
-        ]
-        m["epoch"] += 1
-    elif all(s["name"] != name for s in m["standbys"]):
-        m["standbys"].append(entry)
-        m["epoch"] += 1
-    state = "active" if m["active"]["name"] == name else "standby"
+
+    my_rank = next(
+        (
+            int(r) for r, e in m["actives"].items()
+            if e["name"] == name
+        ),
+        None,
+    )
+    if my_rank is not None:
+        if m["actives"][str(my_rank)]["addr"] != addr:
+            m["epoch"] += 1
+        m["actives"][str(my_rank)] = entry
+    else:
+        if all(s["name"] != name for s in m["standbys"]):
+            m["standbys"].append(entry)
+            m["epoch"] += 1
+        else:
+            m["standbys"] = [
+                entry if s["name"] == name else s
+                for s in m["standbys"]
+            ]
+    _mds_promote_holes(mon, m)
+    _mds_table_maybe_stabilize(m)
+    my_rank = next(
+        (
+            int(r) for r, e in m["actives"].items()
+            if e["name"] == name
+        ),
+        None,
+    )
     return MMonCommandReply(
         rc=0,
-        outb=json.dumps({"state": state, "epoch": m["epoch"]}),
+        outb=json.dumps({
+            "state": "active" if my_rank is not None else "standby",
+            "rank": -1 if my_rank is None else my_rank,
+            "epoch": m["epoch"],
+            "subtrees": m["subtrees"],
+            "table_epoch": m["table_epoch"],
+            "actives": {
+                r: e["addr"] for r, e in m["actives"].items()
+            },
+        }),
+    )
+
+
+def _cmd_mds_set_max(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """'mds set-max-mds' (fs set max_mds): grow/shrink the active
+    rank count; standbys promote into new ranks on their next
+    beacons.  Shrinking evicts the highest ranks (their subtrees
+    re-pin to 0)."""
+    m = _mdsmap_of(mon)
+    n = int(cmd["max_mds"])
+    if n < 1:
+        return MMonCommandReply(rc=-22, outs="max_mds >= 1 (-EINVAL)")
+    m["max_mds"] = n
+    for rank in [r for r in m["actives"] if int(r) >= n]:
+        gone = m["actives"].pop(rank)
+        m["standbys"].append(gone)
+        m["table_acks"].pop(gone["name"], None)
+    changed = False
+    for p, r in list(m["subtrees"].items()):
+        if r >= n:
+            m["subtrees"][p] = 0
+            changed = True
+    if changed:
+        m["table_epoch"] += 1
+    _mds_promote_holes(mon, m)
+    m["epoch"] += 1
+    return MMonCommandReply(
+        rc=0, outb=json.dumps({"epoch": m["epoch"]})
+    )
+
+
+def _cmd_mds_pin(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """'mds pin <path> <rank>' — subtree auth delegation (the
+    ceph.dir.pin xattr / export_dir surface, src/mds/MDCache.cc
+    subtree auth + src/mds/Migrator.cc export, reduced to a table
+    flip with a flush barrier): ops under <path> route to <rank>.
+    Clients switch only after every active acks the new table
+    (see _mds_table_maybe_stabilize)."""
+    m = _mdsmap_of(mon)
+    path = "/" + "/".join(p for p in cmd["path"].split("/") if p)
+    rank = int(cmd["rank"])
+    if rank >= m["max_mds"] or rank < 0:
+        return MMonCommandReply(
+            rc=-22, outs=f"rank {rank} out of range (-EINVAL)"
+        )
+    if m["subtrees"].get(path) == rank:
+        return MMonCommandReply(rc=0, outs="no change")
+    m["subtrees"][path] = rank
+    m["table_epoch"] += 1
+    m["epoch"] += 1
+    return MMonCommandReply(
+        rc=0,
+        outb=json.dumps(
+            {"epoch": m["epoch"], "table_epoch": m["table_epoch"]}
+        ),
     )
 
 
 def _cmd_mds_stat(mon: Monitor, cmd: dict) -> MMonCommandReply:
-    m = getattr(mon, "mdsmap", None) or {
-        "epoch": 0, "active": None, "standbys": [],
-    }
+    m = _mdsmap_of(mon)
     return MMonCommandReply(
         rc=0,
         outb=json.dumps(
             {
                 "epoch": m["epoch"],
-                "active": m["active"],
+                # rank-0 compat alias for single-MDS callers
+                "active": m["actives"].get("0"),
+                "actives": m["actives"],
                 "standbys": m["standbys"],
+                "max_mds": m["max_mds"],
+                # clients route by the STABLE table only
+                "subtrees": m["subtrees_stable"],
+                "table_epoch": m["table_epoch"],
             }
         ),
     )
 
 
 def _cmd_mds_fail(mon: Monitor, cmd: dict) -> MMonCommandReply:
-    """Operator-forced failover: demote the active; the next standby
-    beacon claims the rank (its beacon sees active=None)."""
-    m = getattr(mon, "mdsmap", None)
-    if m is None or m["active"] is None:
-        return MMonCommandReply(rc=-2, outs="no active mds (-ENOENT)")
-    was = m["active"]["name"]
-    _fence_mds(mon, m["active"])
-    m["beacons"].pop(was, None)
-    if m["standbys"]:
-        m["active"] = m["standbys"].pop(0)
-    else:
-        m["active"] = None
+    """Operator-forced failover: demote (and fence) an active — by
+    name, rank, or rank 0 by default; the next standby beacon claims
+    the hole."""
+    m = _mdsmap_of(mon)
+    who = str(cmd.get("who", "0"))
+    rank = None
+    for r, e in m["actives"].items():
+        if r == who or e["name"] == who:
+            rank = r
+            break
+    if rank is None:
+        return MMonCommandReply(rc=-2, outs=f"no active {who!r} (-ENOENT)")
+    gone = m["actives"].pop(rank)
+    _fence_mds(mon, gone)
+    m["beacons"].pop(gone["name"], None)
+    m["table_acks"].pop(gone["name"], None)
+    _mds_promote_holes(mon, m)
     m["epoch"] += 1
     return MMonCommandReply(
-        rc=0, outs=f"failed mds {was}",
+        rc=0, outs=f"failed mds {gone['name']}",
         outb=json.dumps({"epoch": m["epoch"]}),
     )
 
@@ -911,6 +1055,8 @@ _COMMANDS = {
     "mds beacon": _cmd_mds_beacon,
     "mds stat": _cmd_mds_stat,
     "mds fail": _cmd_mds_fail,
+    "mds set-max-mds": _cmd_mds_set_max,
+    "mds pin": _cmd_mds_pin,
     "mgr beacon": _cmd_mgr_beacon,
     "mgr stat": _cmd_mgr_stat,
     "osd pool set": _cmd_pool_set,
