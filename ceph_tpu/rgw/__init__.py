@@ -41,9 +41,18 @@ request and rejects mismatches/stale dates with 403.  Multipart
 MANIFEST head (the reference's multipart manifest), so GET streams
 part reads and the "-N" composite etag matches S3's shape.
 
-Deviations, documented: keystone/STS, lifecycle, multisite, CORS and
-ACLs absent; region/service names checked only for self-consistency;
-single pool.
+ACLs (round 5, acl.py — src/rgw/rgw_acl.cc): buckets and objects
+carry owner + grant lists (canned x-amz-acl or explicit), enforced
+on EVERY op — anonymous requests match only AllUsers grants; ?acl
+subresources read/write policies under READ_ACP/WRITE_ACP.
+Lifecycle (round 5, lifecycle.py — src/rgw/rgw_lc.cc): per-bucket
+expiration + storage-class transition rules applied by a scanning
+worker; COLD transition really recompresses the payload through the
+compressor registry; ``?lifecycle`` subresource round-trips configs.
+
+Deviations, documented: keystone/STS, multisite, CORS absent;
+region/service names checked only for self-consistency; single
+pool; lifecycle configs are JSON on the wire (not S3's XML schema).
 """
 
 from __future__ import annotations
@@ -57,12 +66,20 @@ import urllib.parse
 from xml.sax.saxutils import escape
 
 from ..osdc.objecter import ObjectNotFound, RadosError
+from . import acl as aclmod
+from .lifecycle import LCWorker, apply_rules
 
-__all__ = ["RGW", "RGWError", "sign_request"]
+__all__ = ["RGW", "RGWError", "AccessDenied", "sign_request"]
 
 BUCKETS_DIR = "rgw.buckets"
 USERS_OID = "rgw.users"
+LC_OID = "rgw.lc"  # lifecycle configs: bucket -> rules (lc shard role)
 SKEW = 900.0  # max x-amz-date clock skew (seconds)
+# storage-layer callers that bypass ACLs (internal plumbing, admin
+# tools, tests of the storage logic itself) pass SYSTEM — the
+# reference's system-user bypass in verify_permission
+SYSTEM = "__rgw_system__"
+_DENIED = object()  # HTTP sentinel: signature rejected, 403 sent
 
 
 def _hmac(key: bytes, msg: str) -> bytes:
@@ -173,6 +190,8 @@ class RGW:
         self.server = None
         self.port = 0
         self.auth = auth
+        self.lc_worker = None
+        self.lc_debug = False
 
     # -- users / auth (rgw_user + rgw_auth_s3 roles) -----------------------
     def create_user(self, name: str) -> tuple[str, str]:
@@ -245,6 +264,102 @@ class RGW:
             raise AccessDenied("signature mismatch")
         return user["name"]
 
+    # -- ACL plumbing (rgw_acl.cc verify_permission seat) ------------------
+    @staticmethod
+    def _index_oid(bucket: str) -> str:
+        return _index_oid(bucket)
+
+    def _bucket_rec(self, bucket: str) -> dict:
+        raw = self._buckets().get(bucket)
+        if raw is None:
+            raise RGWError(f"no bucket {bucket!r}")
+        try:
+            rec = json.loads(raw)
+            if not isinstance(rec, dict):
+                raise ValueError
+            return rec
+        except ValueError:
+            # legacy record (bare ctime string): system-owned
+            return {"ctime": raw.decode(), "owner": None,
+                    "acl": aclmod.make_acl(None)}
+
+    def _save_bucket_rec(self, bucket: str, rec: dict) -> None:
+        self.io.omap_set(
+            BUCKETS_DIR, {bucket: json.dumps(rec).encode()}
+        )
+
+    def _require(
+        self,
+        user,
+        perm: str,
+        acl: dict | None,
+        bucket_owner: str | None = None,
+        what: str = "",
+    ) -> None:
+        if user == SYSTEM:
+            return
+        if not aclmod.check(acl, user, perm, bucket_owner):
+            raise AccessDenied(
+                f"{user or 'anonymous'} lacks {perm} on {what}"
+            )
+
+    def _require_owner(self, user, rec: dict, what: str) -> None:
+        """Owner-only ops (DeleteBucket, lifecycle management): the
+        caller must BE the bucket owner — an owner-less (system)
+        bucket is manageable only by SYSTEM callers, and anonymous
+        NEVER passes (None == None must not authorize)."""
+        if user == SYSTEM:
+            return
+        owner = rec.get("owner")
+        if user is None or owner is None or user != owner:
+            raise AccessDenied(
+                f"{user or 'anonymous'} does not own {what}"
+            )
+
+    def set_bucket_acl(
+        self, bucket: str, canned: str, user=SYSTEM
+    ) -> None:
+        rec = self._bucket_rec(bucket)
+        self._require(
+            user, aclmod.WRITE_ACP, rec.get("acl"),
+            rec.get("owner"), bucket,
+        )
+        rec["acl"] = aclmod.make_acl(rec.get("owner"), canned)
+        self._save_bucket_rec(bucket, rec)
+
+    def get_bucket_acl(self, bucket: str, user=SYSTEM) -> dict:
+        rec = self._bucket_rec(bucket)
+        self._require(
+            user, aclmod.READ_ACP, rec.get("acl"),
+            rec.get("owner"), bucket,
+        )
+        return rec.get("acl") or aclmod.make_acl(rec.get("owner"))
+
+    def set_object_acl(
+        self, bucket: str, key: str, canned: str, user=SYSTEM
+    ) -> None:
+        rec = self._bucket_rec(bucket)
+        entry = self.stat_object(bucket, key)
+        self._require(
+            user, aclmod.WRITE_ACP, entry.get("acl"),
+            rec.get("owner"), f"{bucket}/{key}",
+        )
+        entry["acl"] = aclmod.make_acl(entry.get("owner"), canned)
+        self.io.omap_set(
+            _index_oid(bucket), {key: json.dumps(entry).encode()}
+        )
+
+    def get_object_acl(self, bucket: str, key: str, user=SYSTEM) -> dict:
+        rec = self._bucket_rec(bucket)
+        entry = self.stat_object(bucket, key)
+        self._require(
+            user, aclmod.READ_ACP, entry.get("acl"),
+            rec.get("owner"), f"{bucket}/{key}",
+        )
+        return entry.get("acl") or aclmod.make_acl(
+            entry.get("owner")
+        )
+
     # -- storage logic (rgw_rados roles) -----------------------------------
     def _buckets(self) -> dict[str, bytes]:
         try:
@@ -252,30 +367,56 @@ class RGW:
         except (ObjectNotFound, RadosError):
             return {}
 
-    def create_bucket(self, bucket: str) -> None:
+    def create_bucket(
+        self, bucket: str, user=SYSTEM, canned: str = "private"
+    ) -> None:
+        if user is None:
+            # S3: bucket creation always needs an authenticated
+            # identity — there is no ACL yet to grant it
+            raise AccessDenied("anonymous cannot create buckets")
         if "/" in bucket or not bucket:
             raise RGWError(f"invalid bucket name {bucket!r}")
         if bucket in self._buckets():
             raise RGWError(f"bucket {bucket!r} exists")
+        owner = None if user == SYSTEM else user
         self.io.write_full(_index_oid(bucket), b"")
-        self.io.omap_set(
-            BUCKETS_DIR, {bucket: str(time.time()).encode()}
+        self._save_bucket_rec(
+            bucket,
+            {
+                "ctime": time.time(),
+                "owner": owner,
+                "acl": aclmod.make_acl(owner, canned),
+            },
         )
 
-    def delete_bucket(self, bucket: str) -> None:
-        if bucket not in self._buckets():
-            raise RGWError(f"no bucket {bucket!r}")
+    def delete_bucket(self, bucket: str, user=SYSTEM) -> None:
+        rec = self._bucket_rec(bucket)
+        # DeleteBucket is OWNER-only (S3/RGW): a public-read-write
+        # WRITE grant covers objects, never the bucket itself
+        self._require_owner(user, rec, bucket)
         if self.io.omap_get_vals(_index_oid(bucket), max_return=1):
             raise RGWError(f"bucket {bucket!r} not empty")
         self.io.remove(_index_oid(bucket))
         self.io.omap_rm_keys(BUCKETS_DIR, [bucket])
+        self.io.omap_rm_keys(LC_OID, [bucket])
 
-    def put_object(self, bucket: str, key: str, data: bytes) -> str:
-        if bucket not in self._buckets():
-            raise RGWError(f"no bucket {bucket!r}")
+    def put_object(
+        self,
+        bucket: str,
+        key: str,
+        data: bytes,
+        user=SYSTEM,
+        canned: str = "private",
+    ) -> str:
+        rec = self._bucket_rec(bucket)
+        self._require(
+            user, aclmod.WRITE, rec.get("acl"), rec.get("owner"),
+            bucket,
+        )
         etag = hashlib.md5(data).hexdigest()
         self._drop_object_data(bucket, key)  # stale manifest parts
         self.io.write_full(_data_oid(bucket, key), data)
+        owner = None if user in (SYSTEM, None) else user
         # the index entry commits AFTER the data (the reference's
         # prepare/complete index transaction, collapsed)
         self.io.omap_set(
@@ -286,20 +427,36 @@ class RGW:
                         "size": len(data),
                         "etag": etag,
                         "mtime": time.time(),
+                        "owner": owner,
+                        "acl": aclmod.make_acl(owner, canned),
                     }
                 ).encode()
             },
         )
         return etag
 
-    def get_object(self, bucket: str, key: str) -> bytes:
+    def get_object(self, bucket: str, key: str, user=SYSTEM) -> bytes:
+        rec = self._bucket_rec(bucket)
         entry = self.stat_object(bucket, key)  # -ENOENT via index
+        self._require(
+            user, aclmod.READ, entry.get("acl"), rec.get("owner"),
+            f"{bucket}/{key}",
+        )
         if "parts" in entry:
             data = b"".join(
                 self.io.read(oid) for oid in entry["parts"]
             )
         else:
-            data = self.io.read(_data_oid(bucket, key))
+            data = self.io.read(
+                entry.get("data_oid") or _data_oid(bucket, key)
+            )
+        codec = entry.get("compression")
+        if codec:
+            # a lifecycle transition re-wrote the payload through the
+            # compressor; reads stay transparent
+            from ..compressor import create as compressor_create
+
+            data = compressor_create(codec).decompress(data)
         if len(data) != entry["size"]:
             raise RGWError(f"{bucket}/{key}: torn object")
         return data
@@ -310,15 +467,131 @@ class RGW:
             raise ObjectNotFound(f"{bucket}/{key}")
         return json.loads(vals[key])
 
-    def delete_object(self, bucket: str, key: str) -> None:
+    def delete_object(self, bucket: str, key: str, user=SYSTEM) -> None:
+        rec = self._bucket_rec(bucket)
+        self._require(
+            user, aclmod.WRITE, rec.get("acl"), rec.get("owner"),
+            bucket,
+        )
         self.stat_object(bucket, key)
         self._drop_object_data(bucket, key)
         self.io.omap_rm_keys(_index_oid(bucket), [key])
 
+    # -- lifecycle (rgw_lc.cc reduced; see lifecycle.py) -------------------
+    def put_bucket_lifecycle(
+        self, bucket: str, rules: list[dict], user=SYSTEM
+    ) -> None:
+        rec = self._bucket_rec(bucket)
+        # S3: only the bucket owner manages lifecycle
+        self._require_owner(user, rec, bucket)
+        if not isinstance(rules, list):
+            raise RGWError("lifecycle config must be a rule list")
+        for rule in rules:
+            if not isinstance(rule, dict):
+                raise RGWError("each lifecycle rule must be an object")
+            if (
+                "expiration_days" not in rule
+                and "transition_days" not in rule
+            ):
+                raise RGWError("rule needs expiration or transition")
+            for f in ("expiration_days", "transition_days"):
+                if f in rule:
+                    try:
+                        float(rule[f])
+                    except (TypeError, ValueError):
+                        raise RGWError(f"{f} must be numeric")
+            if not isinstance(rule.get("prefix", ""), str):
+                raise RGWError("prefix must be a string")
+        try:
+            self.io.stat(LC_OID)
+        except (ObjectNotFound, RadosError):
+            self.io.write_full(LC_OID, b"")
+        self.io.omap_set(
+            LC_OID, {bucket: json.dumps(rules).encode()}
+        )
+
+    def get_bucket_lifecycle(self, bucket: str, user=SYSTEM) -> list:
+        rec = self._bucket_rec(bucket)
+        self._require_owner(user, rec, bucket)
+        try:
+            raw = self.io.omap_get_vals(LC_OID).get(bucket)
+        except (ObjectNotFound, RadosError):
+            raw = None
+        return json.loads(raw) if raw else []
+
+    def delete_bucket_lifecycle(self, bucket: str, user=SYSTEM) -> None:
+        rec = self._bucket_rec(bucket)
+        self._require_owner(user, rec, bucket)
+        self.io.omap_rm_keys(LC_OID, [bucket])
+
+    def lc_process(self, debug: bool | None = None) -> dict:
+        """One scan over every configured bucket (RGWLC::process)."""
+        debug = self.lc_debug if debug is None else debug
+        totals = {"expired": 0, "transitioned": 0}
+        try:
+            configs = self.io.omap_get_vals(LC_OID)
+        except (ObjectNotFound, RadosError):
+            return totals
+        for bucket, raw in configs.items():
+            stats = apply_rules(self, bucket, json.loads(raw), debug)
+            for k in totals:
+                totals[k] += stats[k]
+        return totals
+
+    def _transition_object(
+        self, bucket: str, key: str, storage_class: str
+    ) -> None:
+        """Move an object to the cold tier: recompress the payload
+        (zlib — the framework's real second storage tier) and tag
+        the entry.  Multipart manifests consolidate to one blob."""
+        from ..compressor import create as compressor_create
+
+        entry = self.stat_object(bucket, key)
+        data = self.get_object(bucket, key, user=SYSTEM)
+        comp = compressor_create("zlib")
+        blob = comp.compress(data)
+        old_oids = entry.pop("parts", None) or [
+            entry.get("data_oid") or _data_oid(bucket, key)
+        ]
+        # write the cold blob to a NEW oid, flip the index entry to
+        # it, THEN drop the old payload: a concurrent reader holding
+        # either entry version reads a consistent (oid, entry) pair —
+        # only a reader stale across the final delete sees a
+        # transient miss, never a torn object
+        cold_oid = _data_oid(bucket, key) + "#cold"
+        self.io.write_full(cold_oid, blob)
+        entry["data_oid"] = cold_oid
+        entry["storage_class"] = storage_class
+        entry["compression"] = "zlib"
+        self.io.omap_set(
+            _index_oid(bucket), {key: json.dumps(entry).encode()}
+        )
+        for oid in old_oids:
+            if oid == cold_oid:
+                continue
+            try:
+                self.io.remove(oid)
+            except (ObjectNotFound, RadosError):
+                pass
+
+    def start_lc(
+        self, interval: float = 1.0, debug: bool = False
+    ) -> None:
+        """Run the lifecycle worker (RGWLC::start_processor);
+        ``debug`` makes *_days count seconds (rgw_lc_debug_interval)."""
+        self.lc_debug = debug
+        if self.lc_worker is None:
+            self.lc_worker = LCWorker(self, interval, debug)
+
     # -- multipart (rgw multipart manifest role) ---------------------------
-    def initiate_multipart(self, bucket: str, key: str) -> str:
-        if bucket not in self._buckets():
-            raise RGWError(f"no bucket {bucket!r}")
+    def initiate_multipart(
+        self, bucket: str, key: str, user=SYSTEM
+    ) -> str:
+        rec = self._bucket_rec(bucket)
+        self._require(
+            user, aclmod.WRITE, rec.get("acl"), rec.get("owner"),
+            bucket,
+        )
         import os as _os
 
         upload_id = _os.urandom(8).hex()
@@ -356,8 +629,13 @@ class RGW:
 
     def upload_part(
         self, bucket: str, key: str, upload_id: str, part: int,
-        data: bytes,
+        data: bytes, user=SYSTEM,
     ) -> str:
+        rec = self._bucket_rec(bucket)
+        self._require(
+            user, aclmod.WRITE, rec.get("acl"), rec.get("owner"),
+            bucket,
+        )
         if not 1 <= part <= 10000:
             raise RGWError("part number out of range")
         self._mp_check(bucket, key, upload_id)
@@ -378,11 +656,16 @@ class RGW:
         return etag
 
     def complete_multipart(
-        self, bucket: str, key: str, upload_id: str
+        self, bucket: str, key: str, upload_id: str, user=SYSTEM
     ) -> str:
         """Write the manifest HEAD: the object's index entry points
         at the part objects (no data copy), with the S3-shaped
         composite '-N' etag."""
+        rec = self._bucket_rec(bucket)
+        self._require(
+            user, aclmod.WRITE, rec.get("acl"), rec.get("owner"),
+            bucket,
+        )
         self._mp_check(bucket, key, upload_id)
         by_num = self._mp_parts(bucket, key, upload_id)
         if not by_num:
@@ -395,6 +678,7 @@ class RGW:
             hashlib.md5(md5s).hexdigest() + f"-{len(parts)}"
         )
         self._drop_object_data(bucket, key)  # overwrite semantics
+        owner = None if user in (SYSTEM, None) else user
         self.io.omap_set(
             _index_oid(bucket),
             {
@@ -403,6 +687,8 @@ class RGW:
                         "size": sum(m["size"] for _n, m in parts),
                         "etag": etag,
                         "mtime": time.time(),
+                        "owner": owner,
+                        "acl": aclmod.make_acl(owner),
                         "parts": [
                             _part_oid(bucket, key, upload_id, n)
                             for n, _m in parts
@@ -422,8 +708,13 @@ class RGW:
         return etag
 
     def abort_multipart(
-        self, bucket: str, key: str, upload_id: str
+        self, bucket: str, key: str, upload_id: str, user=SYSTEM
     ) -> None:
+        rec = self._bucket_rec(bucket)
+        self._require(
+            user, aclmod.WRITE, rec.get("acl"), rec.get("owner"),
+            bucket,
+        )
         self._mp_check(bucket, key, upload_id)
         by_num = self._mp_parts(bucket, key, upload_id)
         for n in by_num:
@@ -445,19 +736,29 @@ class RGW:
             entry = self.stat_object(bucket, key)
         except ObjectNotFound:
             return
-        for oid in entry.get("parts", [_data_oid(bucket, key)]):
+        oids = entry.get("parts") or [
+            entry.get("data_oid") or _data_oid(bucket, key)
+        ]
+        for oid in oids:
             try:
                 self.io.remove(oid)
             except (ObjectNotFound, RadosError):
                 pass
 
     def list_objects(
-        self, bucket: str, marker: str = "", max_keys: int = 1000
+        self,
+        bucket: str,
+        marker: str = "",
+        max_keys: int = 1000,
+        user=SYSTEM,
     ) -> tuple[list[dict], bool]:
         """Key-ordered page after ``marker`` → (entries, truncated):
         one omap page read, the bucket-index listing."""
-        if bucket not in self._buckets():
-            raise RGWError(f"no bucket {bucket!r}")
+        rec = self._bucket_rec(bucket)
+        self._require(
+            user, aclmod.READ, rec.get("acl"), rec.get("owner"),
+            bucket,
+        )
         vals = self.io.omap_get_vals(
             _index_oid(bucket), start_after=marker,
             max_return=max_keys + 1,
@@ -493,6 +794,11 @@ class RGW:
                     self.wfile.write(body)
 
             def _err(self, code, name, msg):
+                if self.command == "HEAD":
+                    # HEAD responses must not carry a body or the
+                    # keep-alive stream desyncs
+                    self._reply(code)
+                    return
                 body = (
                     f"<Error><Code>{name}</Code>"
                     f"<Message>{escape(msg)}</Message></Error>"
@@ -515,10 +821,16 @@ class RGW:
                 length = int(self.headers.get("Content-Length", 0))
                 return self.rfile.read(length) if length else b""
 
-            def _authorize(self, method, payload) -> bool:
-                """SigV4 gate (when the gateway runs with auth)."""
+            def _user(self, method, payload):
+                """Request identity: SYSTEM when the gateway runs
+                authless, the verified user for a signed request,
+                None for an ANONYMOUS one (no Authorization header —
+                the ACLs decide what it may do), or _DENIED (403
+                already sent) on a bad signature."""
                 if not gw.auth:
-                    return True
+                    return SYSTEM
+                if not self.headers.get("Authorization"):
+                    return None
                 parsed = urllib.parse.urlparse(self.path)
                 q = dict(
                     urllib.parse.parse_qsl(
@@ -526,7 +838,7 @@ class RGW:
                     )
                 )
                 try:
-                    gw._verify(
+                    return gw._verify(
                         method, parsed.path, q,
                         {
                             k.lower() if k.lower().startswith("x-amz")
@@ -535,18 +847,48 @@ class RGW:
                         },
                         payload,
                     )
-                    return True
                 except AccessDenied as e:
                     self._err(403, "AccessDenied", str(e))
-                    return False
+                    return _DENIED
 
             def do_GET(self):  # noqa: N802
                 bucket, key, q = self._route()
-                if not self._authorize("GET", b""):
+                user = self._user("GET", b"")
+                if user is _DENIED:
                     return
                 try:
-                    if bucket is None:
-                        names = sorted(gw._buckets())
+                    if bucket is not None and "acl" in q:
+                        policy = (
+                            gw.get_bucket_acl(bucket, user=user)
+                            if key is None
+                            else gw.get_object_acl(
+                                bucket, key, user=user
+                            )
+                        )
+                        self._reply(
+                            200, json.dumps(policy).encode(),
+                            ctype="application/json",
+                        )
+                    elif bucket is not None and key is None and (
+                        "lifecycle" in q
+                    ):
+                        rules = gw.get_bucket_lifecycle(
+                            bucket, user=user
+                        )
+                        self._reply(
+                            200, json.dumps(rules).encode(),
+                            ctype="application/json",
+                        )
+                    elif bucket is None:
+                        if user is None:
+                            raise AccessDenied(
+                                "anonymous cannot list buckets"
+                            )
+                        names = sorted(
+                            b for b, raw in gw._buckets().items()
+                            if user == SYSTEM
+                            or gw._bucket_rec(b).get("owner") == user
+                        )
                         inner = "".join(
                             f"<Bucket><Name>{escape(n)}</Name></Bucket>"
                             for n in names
@@ -564,6 +906,7 @@ class RGW:
                             bucket,
                             marker=q.get("marker", ""),
                             max_keys=int(q.get("max-keys", 1000)),
+                            user=user,
                         )
                         inner = "".join(
                             "<Contents>"
@@ -584,11 +927,13 @@ class RGW:
                             ).encode(),
                         )
                     else:
-                        data = gw.get_object(bucket, key)
+                        data = gw.get_object(bucket, key, user=user)
                         self._reply(
                             200, data,
                             ctype="application/octet-stream",
                         )
+                except AccessDenied as e:
+                    self._err(403, "AccessDenied", str(e))
                 except ObjectNotFound as e:
                     self._err(404, "NoSuchKey", str(e))
                 except RGWError as e:
@@ -596,10 +941,16 @@ class RGW:
 
             def do_HEAD(self):  # noqa: N802
                 bucket, key, _q = self._route()
-                if not self._authorize("HEAD", b""):
+                user = self._user("HEAD", b"")
+                if user is _DENIED:
                     return
                 try:
+                    rec = gw._bucket_rec(bucket)
                     entry = gw.stat_object(bucket, key)
+                    gw._require(
+                        user, aclmod.READ, entry.get("acl"),
+                        rec.get("owner"), f"{bucket}/{key}",
+                    )
                     self._reply(
                         200, b"",
                         headers={
@@ -607,45 +958,79 @@ class RGW:
                             "X-Object-Size": str(entry["size"]),
                         },
                     )
+                except AccessDenied:
+                    self._reply(403)
                 except (ObjectNotFound, RGWError):
                     self._reply(404)
 
             def do_PUT(self):  # noqa: N802
                 bucket, key, q = self._route()
                 body = self._body()
-                if not self._authorize("PUT", body):
+                user = self._user("PUT", body)
+                if user is _DENIED:
                     return
+                canned = self.headers.get("x-amz-acl", "private")
                 try:
-                    if key is not None and "uploadId" in q:
+                    if bucket is not None and "acl" in q:
+                        if key is None:
+                            gw.set_bucket_acl(
+                                bucket, canned, user=user
+                            )
+                        else:
+                            gw.set_object_acl(
+                                bucket, key, canned, user=user
+                            )
+                        self._reply(200)
+                    elif bucket is not None and key is None and (
+                        "lifecycle" in q
+                    ):
+                        gw.put_bucket_lifecycle(
+                            bucket, json.loads(body), user=user
+                        )
+                        self._reply(200)
+                    elif key is not None and "uploadId" in q:
                         try:
                             part = int(q.get("partNumber", 0))
                         except ValueError:
                             raise RGWError("bad partNumber")
                         etag = gw.upload_part(
                             bucket, key, q["uploadId"], part, body,
+                            user=user,
                         )
                         self._reply(
                             200, b"", headers={"ETag": f'"{etag}"'}
                         )
                     elif key is None:
-                        gw.create_bucket(bucket)
+                        gw.create_bucket(
+                            bucket, user=user, canned=canned
+                        )
                         self._reply(200)
                     else:
-                        etag = gw.put_object(bucket, key, body)
+                        etag = gw.put_object(
+                            bucket, key, body, user=user,
+                            canned=canned,
+                        )
                         self._reply(
                             200, b"", headers={"ETag": f'"{etag}"'}
                         )
+                except AccessDenied as e:
+                    self._err(403, "AccessDenied", str(e))
+                except (ValueError, KeyError) as e:
+                    self._err(400, "MalformedRequest", str(e))
                 except RGWError as e:
                     self._err(409, "BucketError", str(e))
 
             def do_POST(self):  # noqa: N802
                 bucket, key, q = self._route()
                 body = self._body()
-                if not self._authorize("POST", body):
+                user = self._user("POST", body)
+                if user is _DENIED:
                     return
                 try:
                     if key is not None and "uploads" in q:
-                        upload_id = gw.initiate_multipart(bucket, key)
+                        upload_id = gw.initiate_multipart(
+                            bucket, key, user=user
+                        )
                         self._reply(
                             200,
                             (
@@ -658,7 +1043,7 @@ class RGW:
                         )
                     elif key is not None and "uploadId" in q:
                         etag = gw.complete_multipart(
-                            bucket, key, q["uploadId"]
+                            bucket, key, q["uploadId"], user=user
                         )
                         self._reply(
                             200,
@@ -670,21 +1055,30 @@ class RGW:
                         )
                     else:
                         self._err(400, "InvalidRequest", "bad POST")
+                except AccessDenied as e:
+                    self._err(403, "AccessDenied", str(e))
                 except RGWError as e:
                     self._err(409, "UploadError", str(e))
 
             def do_DELETE(self):  # noqa: N802
                 bucket, key, q = self._route()
-                if not self._authorize("DELETE", b""):
+                user = self._user("DELETE", b"")
+                if user is _DENIED:
                     return
                 try:
                     if key is not None and "uploadId" in q:
-                        gw.abort_multipart(bucket, key, q["uploadId"])
+                        gw.abort_multipart(
+                            bucket, key, q["uploadId"], user=user
+                        )
+                    elif key is None and "lifecycle" in q:
+                        gw.delete_bucket_lifecycle(bucket, user=user)
                     elif key is None:
-                        gw.delete_bucket(bucket)
+                        gw.delete_bucket(bucket, user=user)
                     else:
-                        gw.delete_object(bucket, key)
+                        gw.delete_object(bucket, key, user=user)
                     self._reply(204)
+                except AccessDenied as e:
+                    self._err(403, "AccessDenied", str(e))
                 except ObjectNotFound as e:
                     self._err(404, "NoSuchKey", str(e))
                 except RGWError as e:
@@ -702,5 +1096,8 @@ class RGW:
         return self.port
 
     def shutdown(self) -> None:
+        if self.lc_worker is not None:
+            self.lc_worker.stop()
+            self.lc_worker = None
         if self.server is not None:
             self.server.shutdown()

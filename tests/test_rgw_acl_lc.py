@@ -1,0 +1,219 @@
+"""RGW ACLs + lifecycle (src/rgw/rgw_acl.cc, src/rgw/rgw_lc.cc;
+VERDICT round-4 ask #6).
+
+The proofs: a public-read vs owner-only semantics matrix passes for
+owner / other-user / anonymous across object and bucket ops; an
+expiration rule removes objects under a live workload; a transition
+rule recompresses payloads into the cold tier with transparent
+reads."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ceph_tpu.rados import Rados
+from ceph_tpu.rgw import RGW, AccessDenied, RGWError, sign_request
+
+from test_osd_daemon import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster()
+    for i in range(3):
+        c.start_osd(i)
+    c.wait_active()
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def gw(cluster):
+    r = Rados("acl-test").connect(*cluster.mon_addr)
+    r.pool_create("aclpool", pg_num=2, size=3)
+    g = RGW(r.open_ioctx("aclpool"), auth=True)
+    try:
+        yield g
+    finally:
+        g.shutdown()
+        r.shutdown()
+
+
+def test_acl_matrix_storage_layer(gw):
+    """The S3 semantics matrix at the storage layer: owner, another
+    authenticated user, and anonymous against private / public-read
+    / public-read-write resources."""
+    gw.create_bucket("matrix", user="alice")
+    gw.put_object("matrix", "secret.txt", b"top", user="alice")
+
+    # --- private (default): owner only
+    assert gw.get_object("matrix", "secret.txt", user="alice") == b"top"
+    with pytest.raises(AccessDenied):
+        gw.get_object("matrix", "secret.txt", user="bob")
+    with pytest.raises(AccessDenied):
+        gw.get_object("matrix", "secret.txt", user=None)
+    with pytest.raises(AccessDenied):
+        gw.list_objects("matrix", user="bob")
+    with pytest.raises(AccessDenied):
+        gw.put_object("matrix", "x", b"", user="bob")
+    with pytest.raises(AccessDenied):
+        gw.delete_object("matrix", "secret.txt", user="bob")
+
+    # --- public-read on the OBJECT: reads open, writes still closed
+    gw.set_object_acl("matrix", "secret.txt", "public-read",
+                      user="alice")
+    assert gw.get_object("matrix", "secret.txt", user="bob") == b"top"
+    assert gw.get_object("matrix", "secret.txt", user=None) == b"top"
+    with pytest.raises(AccessDenied):
+        gw.put_object("matrix", "secret.txt", b"nope", user="bob")
+
+    # --- authenticated-read: bob yes, anonymous no
+    gw.set_object_acl("matrix", "secret.txt", "authenticated-read",
+                      user="alice")
+    assert gw.get_object("matrix", "secret.txt", user="bob") == b"top"
+    with pytest.raises(AccessDenied):
+        gw.get_object("matrix", "secret.txt", user=None)
+
+    # --- bucket public-read: listing opens, object acl still rules
+    gw.set_bucket_acl("matrix", "public-read", user="alice")
+    entries, _ = gw.list_objects("matrix", user=None)
+    assert [e["key"] for e in entries] == ["secret.txt"]
+    # --- bucket public-read-write: bob can put; HIS object is his
+    gw.set_bucket_acl("matrix", "public-read-write", user="alice")
+    gw.put_object("matrix", "bob.txt", b"bobdata", user="bob")
+    assert gw.get_object("matrix", "bob.txt", user="bob") == b"bobdata"
+    # alice reads bob's object too: the BUCKET owner always may
+    assert gw.get_object("matrix", "bob.txt", user="alice") == b"bobdata"
+    with pytest.raises(AccessDenied):
+        gw.get_object("matrix", "bob.txt", user="carol")
+
+    # --- only WRITE_ACP holders may change policies
+    with pytest.raises(AccessDenied):
+        gw.set_bucket_acl("matrix", "private", user="bob")
+    with pytest.raises(AccessDenied):
+        gw.set_object_acl("matrix", "secret.txt", "public-read",
+                          user="bob")
+
+
+def test_acl_over_http(gw):
+    """public-read vs owner-only through the REAL HTTP frontend with
+    SigV4 identities and anonymous requests."""
+    access, secret = gw.create_user("webuser")
+    port = gw.serve()
+    base = f"http://127.0.0.1:{port}"
+
+    def call(method, path, payload=b"", signed=True, headers=None,
+             query=None):
+        q = dict(query or {})
+        url = base + path
+        if q:
+            url += "?" + urllib.parse.urlencode(q)
+        req = urllib.request.Request(
+            url, data=payload if payload else None, method=method
+        )
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
+        if signed:
+            for k, v in sign_request(
+                method, path, q, payload, access, secret
+            ).items():
+                req.add_header(k, v)
+        return urllib.request.urlopen(req, timeout=10)
+
+    import urllib.parse
+
+    assert call("PUT", "/web").status == 200
+    assert call("PUT", "/web/page", payload=b"<html>").status == 200
+
+    # owner-only: anonymous GET bounces 403
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        call("GET", "/web/page", signed=False)
+    assert ei.value.code == 403
+
+    # flip the object public-read via the ?acl subresource
+    assert call(
+        "PUT", "/web/page", query={"acl": ""},
+        headers={"x-amz-acl": "public-read"},
+    ).status == 200
+    got = call("GET", "/web/page", signed=False)
+    assert got.read() == b"<html>"
+    # anonymous still cannot write
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        call("PUT", "/web/page", payload=b"defaced", signed=False)
+    assert ei.value.code == 403
+    # policy readable via ?acl (owner)
+    policy = json.loads(
+        call("GET", "/web/page", query={"acl": ""}).read()
+    )
+    assert policy["grants"] == [{"grantee": "ALL", "perms": ["READ"]}]
+
+
+def test_lifecycle_expiration_under_live_workload(gw):
+    gw.create_bucket("lcbuck", user="alice")
+    gw.put_bucket_lifecycle(
+        "lcbuck",
+        [{"id": "exp-old", "prefix": "logs/",
+          "status": "Enabled", "expiration_days": 1}],
+        user="alice",
+    )
+    # lifecycle config round-trips and is owner-gated
+    assert gw.get_bucket_lifecycle("lcbuck", user="alice")[0][
+        "id"
+    ] == "exp-old"
+    with pytest.raises(AccessDenied):
+        gw.put_bucket_lifecycle("lcbuck", [], user="bob")
+
+    gw.put_object("lcbuck", "logs/old.log", b"x" * 100, user="alice")
+    gw.put_object("lcbuck", "keep/forever", b"y", user="alice")
+    gw.start_lc(interval=0.2, debug=True)  # debug: days == seconds
+    time.sleep(1.2)
+    # live workload during the scan window
+    for i in range(3):
+        gw.put_object("lcbuck", f"logs/new{i}", b"z", user="alice")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        keys = {
+            e["key"] for e in gw.list_objects("lcbuck", user="alice")[0]
+        }
+        if "logs/old.log" not in keys:
+            break
+        time.sleep(0.2)
+    assert "logs/old.log" not in keys, keys
+    # untouched prefixes and fresh objects survive
+    assert "keep/forever" in keys
+    for i in range(3):
+        assert f"logs/new{i}" in keys
+
+
+def test_lifecycle_transition_to_cold(gw):
+    gw.create_bucket("coldbuck", user="alice")
+    payload = b"transition me " * 500
+    gw.put_object("coldbuck", "warm.bin", payload, user="alice")
+    gw.put_bucket_lifecycle(
+        "coldbuck",
+        [{"id": "cool", "prefix": "", "status": "Enabled",
+          "transition_days": 0.2, "storage_class": "COLD"}],
+        user="alice",
+    )
+    time.sleep(0.5)
+    # the background worker (started by the previous test) may beat
+    # this manual pass to it — either way the object must end cold
+    gw.lc_process(debug=True)
+    entry = gw.stat_object("coldbuck", "warm.bin")
+    assert entry["storage_class"] == "COLD"
+    assert entry["compression"] == "zlib"
+    # the stored blob really is the compressed form, at the entry's
+    # cold oid (the old oid is gone — readers follow the entry)
+    raw = gw.io.read(entry["data_oid"])
+    assert len(raw) < len(payload)
+    # ...and reads stay transparent
+    assert gw.get_object("coldbuck", "warm.bin", user="alice") == payload
+    # a second pass is idempotent
+    assert gw.lc_process(debug=True)["transitioned"] == 0
