@@ -945,6 +945,36 @@ class MClientCaps(Message):
 
 @register_message
 @dataclass
+class MRecoveryReserve(Message):
+    """Two-sided recovery/backfill reservation handshake
+    (src/messages/MRecoveryReserve.h + MBackfillReserve.h, the
+    doc/dev/osd_internals/backfill_reservation.rst protocol): the
+    primary REQUESTs a slot at the replica before pushing, the
+    replica GRANTs or DENYs against its own osd_max_backfills cap,
+    and a RELEASE returns the slot when recovery finishes (or
+    fails).  Denied primaries retry on a later tick instead of
+    overrunning a busy peer."""
+
+    TYPE = 44
+    op: str = ""  # "request" | "grant" | "deny" | "release"
+    pgid: str = ""
+    epoch: int = 0
+    from_osd: int = -1
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.op).string(self.pgid)
+        e.u32(self.epoch).s64(self.from_osd)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MRecoveryReserve":
+        return cls(
+            op=d.string(), pgid=d.string(), epoch=d.u32(),
+            from_osd=d.s64(),
+        )
+
+
+@register_message
+@dataclass
 class MMgrReport(Message):
     """Daemon → mgr perf-counter report (src/messages/MMgrReport.h
     role): the daemon name plus a JSON perf dump, pushed on the

@@ -275,13 +275,19 @@ class Connection:
                 fut.set_exception(MessageError("connection reset"))
         try:
             self._writer.close()
+        except Exception:
+            pass
+        else:
             # wait for connection_lost so the transport is truly dead
             # before the loop can be closed — an unfinished transport's
             # __del__ would otherwise call close() on the closed loop
             # (an unraisable "Event loop is closed" at pytest teardown)
-            await asyncio.wait_for(self._writer.wait_closed(), 1.0)
-        except Exception:
-            pass
+            try:
+                await asyncio.wait_for(
+                    self._writer.wait_closed(), 1.0
+                )
+            except Exception:
+                pass
         self.msgr._conn_reset(self)
 
 

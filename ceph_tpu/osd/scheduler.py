@@ -53,6 +53,11 @@ class _SchedulerBase:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._size = 0
+        # recent dequeue classes (observability: tests prove client
+        # ops interleave with a recovery storm from this trace)
+        self.class_log: collections.deque = collections.deque(
+            maxlen=512
+        )
 
     def enqueue(self, klass: str, cost: int, item) -> None:
         with self._cond:
@@ -106,6 +111,7 @@ class WeightedPriorityQueue(_SchedulerBase):
                     raise TimeoutError("queue idle")
             self._size -= 1
             if self._strict:
+                self.class_log.append(CLASS_STRICT)
                 return self._strict.popleft()
             # deficit round-robin: the current class serves while its
             # credit lasts (a burst proportional to its weight), gets
@@ -135,6 +141,7 @@ class WeightedPriorityQueue(_SchedulerBase):
                     self._credit[klass] -= cost
                     if not q:
                         self._credit[klass] = 0.0
+                    self.class_log.append(klass)
                     return item
                 self._rr_pos = (self._rr_pos + 1) % n
                 self._fresh = True
@@ -148,6 +155,7 @@ class WeightedPriorityQueue(_SchedulerBase):
             )
             cost, item = self._queues[best[1]].popleft()
             self._credit[best[1]] = 0.0
+            self.class_log.append(best[1])
             return item
 
 
@@ -233,6 +241,7 @@ class MClockQueue(_SchedulerBase):
         ]
         if due:
             _tag, k = min(due)
+            self.class_log.append(k)
             return self._queues[k].popleft()[3]
         # 2) weight phase among limit-eligible heads
         eligible = [
@@ -242,6 +251,7 @@ class MClockQueue(_SchedulerBase):
         ]
         if eligible:
             _tag, k = min(eligible)
+            self.class_log.append(k)
             return self._queues[k].popleft()[3]
         return None
 
@@ -258,6 +268,7 @@ class MClockQueue(_SchedulerBase):
             while True:
                 if self._strict:
                     self._size -= 1
+                    self.class_log.append(CLASS_STRICT)
                     return self._strict.popleft()
                 if self._size > 0:
                     item = self._pick_locked()

@@ -269,10 +269,8 @@ class RGW:
     def _index_oid(bucket: str) -> str:
         return _index_oid(bucket)
 
-    def _bucket_rec(self, bucket: str) -> dict:
-        raw = self._buckets().get(bucket)
-        if raw is None:
-            raise RGWError(f"no bucket {bucket!r}")
+    @staticmethod
+    def _parse_bucket_rec(raw: bytes) -> dict:
         try:
             rec = json.loads(raw)
             if not isinstance(rec, dict):
@@ -282,6 +280,12 @@ class RGW:
             # legacy record (bare ctime string): system-owned
             return {"ctime": raw.decode(), "owner": None,
                     "acl": aclmod.make_acl(None)}
+
+    def _bucket_rec(self, bucket: str) -> dict:
+        raw = self._buckets().get(bucket)
+        if raw is None:
+            raise RGWError(f"no bucket {bucket!r}")
+        return self._parse_bucket_rec(raw)
 
     def _save_bucket_rec(self, bucket: str, rec: dict) -> None:
         self.io.omap_set(
@@ -887,7 +891,9 @@ class RGW:
                         names = sorted(
                             b for b, raw in gw._buckets().items()
                             if user == SYSTEM
-                            or gw._bucket_rec(b).get("owner") == user
+                            or gw._parse_bucket_rec(raw).get(
+                                "owner"
+                            ) == user
                         )
                         inner = "".join(
                             f"<Bucket><Name>{escape(n)}</Name></Bucket>"

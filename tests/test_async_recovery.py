@@ -1,0 +1,182 @@
+"""Async recovery through the op scheduler with two-sided
+reservations (src/osd/ECBackend.h:249 RecoveryOp,
+doc/dev/osd_internals/backfill_reservation.rst; VERDICT round-4
+ask #7).
+
+The proofs: a revived OSD's recovery storm drains through the
+scheduler's RECOVERY class while CLIENT ops keep being served
+between pushes (the QoS interleave, read from the scheduler's
+dequeue trace); the reservation protocol grants/denies against
+osd_max_backfills and releases cleanly; the recovered replica ends
+byte-identical."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.msg.message import (
+    MRecoveryReserve,
+    OSD_OP_READ,
+    OSD_OP_WRITEFULL,
+)
+from ceph_tpu.osd.scheduler import CLASS_CLIENT, CLASS_RECOVERY
+from ceph_tpu.store.objectstore import MemStore
+
+from test_osd_daemon import OBJ_PREFIX, PG_NUM, POOL, MiniCluster
+
+
+def _pg_of(cluster, oid: str) -> str:
+    from ceph_tpu.osdc.objecter import object_to_pg
+
+    pool = cluster.monc.osdmap.pools[POOL]
+    return object_to_pg(pool, oid)
+
+
+def test_recovery_storm_keeps_client_ops_flowing():
+    c = MiniCluster()
+    try:
+        stores = {i: MemStore() for i in range(3)}
+        for i in range(3):
+            c.start_osd(i, store=stores[i], op_queue="mclock")
+        c.wait_active()
+
+        blob = b"R" * 65536
+        for i in range(24):
+            c.op(_pg_of(c, f"obj{i}"), f"obj{i}",
+                 OSD_OP_WRITEFULL, blob)
+
+        victim = 2
+        c.kill_osd(victim)
+        time.sleep(2.0)  # failure reports -> mon marks it down
+        for i in range(24):
+            c.op(_pg_of(c, f"obj{i}"), f"obj{i}",
+                 OSD_OP_WRITEFULL, blob + f"v2-{i}".encode())
+
+        # revive with its (stale) store: the missing set is the 24
+        # overwrites — a real recovery storm
+        revived = c.start_osd(victim, store=stores[victim],
+                              op_queue="mclock")
+
+        # hammer client ops on the OTHER osds' PGs while the storm
+        # drains; stop once every recovery op completed
+        served = 0
+        deadline = time.monotonic() + 30
+        others = [o for o in c.osds.values() if o.whoami != victim]
+        while time.monotonic() < deadline:
+            c.op(_pg_of(c, "live"), "live", OSD_OP_WRITEFULL, b"x")
+            served += 1
+            busy = any(o._recovering for o in others)
+            saw_pushes = any(
+                CLASS_RECOVERY in o._workq.class_log for o in others
+            )
+            if saw_pushes and not busy and served > 3:
+                break
+            time.sleep(0.02)
+
+        # recovery really flowed through the scheduler's RECOVERY
+        # class, and client ops were served BETWEEN pushes
+        logs = [list(o._workq.class_log) for o in others]
+        combined = max(
+            logs, key=lambda lg: lg.count(CLASS_RECOVERY)
+        )
+        rec_idx = [
+            i for i, k in enumerate(combined) if k == CLASS_RECOVERY
+        ]
+        assert len(rec_idx) >= 5, (
+            f"storm never rode the scheduler: {combined}"
+        )
+        cli_between = [
+            i for i, k in enumerate(combined)
+            if k == CLASS_CLIENT and rec_idx[0] < i < rec_idx[-1]
+        ]
+        assert cli_between, (
+            "client ops starved during the recovery storm"
+        )
+
+        # reservations all released, and the replica converged
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if all(
+                not o._recovering
+                and not o._local_reservations
+                for o in c.osds.values()
+            ) and not revived._remote_reservations:
+                break
+            time.sleep(0.1)
+        assert not revived._remote_reservations
+        for o in c.osds.values():
+            assert not o._local_reservations, o.whoami
+
+        deadline = time.monotonic() + 20
+        want = {
+            f"obj{i}": blob + f"v2-{i}".encode() for i in range(24)
+        }
+        while time.monotonic() < deadline:
+            try:
+                got = {
+                    k: bytes(
+                        revived.store.read(
+                            revived.pgs[_pg_of(c, k)].cid,
+                            OBJ_PREFIX + k,
+                        )
+                    )
+                    for k in want
+                    if _pg_of(c, k) in revived.pgs
+                }
+            except Exception:
+                got = {}
+            mine = {
+                k: v for k, v in want.items()
+                if _pg_of(c, k) in revived.pgs
+                and victim in revived.pgs[_pg_of(c, k)].acting
+            }
+            if mine and all(got.get(k) == v for k, v in mine.items()):
+                break
+            time.sleep(0.2)
+        assert mine, "victim hosts no recovered objects?"
+        for k, v in mine.items():
+            assert got.get(k) == v, f"{k} not recovered"
+    finally:
+        c.shutdown()
+
+
+def test_reservation_grant_deny_release():
+    """The replica-side reservation cap: requests beyond
+    osd_max_backfills are DENIED until a release frees a slot."""
+    c = MiniCluster()
+    try:
+        for i in range(3):
+            c.start_osd(i)
+        c.wait_active()
+        osd = c.osds[0]
+        osd.max_backfills = 1
+        conn = c.client_msgr.connect(*osd.addr)
+
+        def reserve(pgid, frm):
+            return conn.call(MRecoveryReserve(
+                tid=c.client_msgr.new_tid(), op="request",
+                pgid=pgid, epoch=1, from_osd=frm,
+            ), timeout=5.0)
+
+        r1 = reserve("9.0", 7)
+        assert r1.op == "grant"
+        r2 = reserve("9.1", 7)
+        assert r2.op == "deny", "cap not enforced"
+        # re-request of the SAME key is idempotent (still granted)
+        assert reserve("9.0", 7).op == "grant"
+        conn.send(MRecoveryReserve(
+            tid=c.client_msgr.new_tid(), op="release",
+            pgid="9.0", epoch=1, from_osd=7,
+        ))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if reserve("9.1", 7).op == "grant":
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("release never freed the slot")
+    finally:
+        c.shutdown()

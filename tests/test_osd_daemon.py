@@ -59,8 +59,11 @@ class MiniCluster:
         self.monc = MonClient(self.client_msgr, whoami=-1)
         self.monc.connect(*self.mon_addr)
 
-    def start_osd(self, i: int, store=None):
-        osd = OSD(i, store=store, tick_interval=0.2, heartbeat_grace=1.0)
+    def start_osd(self, i: int, store=None, **kw):
+        osd = OSD(
+            i, store=store, tick_interval=0.2, heartbeat_grace=1.0,
+            **kw,
+        )
         osd.boot(*self.mon_addr)
         self.osds[i] = osd
         return osd

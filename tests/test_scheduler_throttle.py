@@ -96,8 +96,8 @@ def test_throttle_blocks_fifo_and_get_or_fail():
     # a small later request must NOT barge past the parked large one
     assert order == []
     t.put(8)  # 0 in flight: first (6) fits, then second (1)
-    a.join(2)
-    b.join(2)
+    a.join(10)
+    b.join(10)
     assert order == ["first", "second"]
     assert t.current == 7
     # timeout path returns the budget untaken
