@@ -436,7 +436,10 @@ class PgAutoscalerModule(MgrModule):
     undersized pool gets a recommendation, and in mode "on" the
     module commits the increase through "osd pool set pg_num"
     (primaries split by stable_mod re-homing when they observe the
-    map).  Erasure pools are skipped (split unsupported there)."""
+    map).  Erasure pools split like any other: the pool-type-agnostic
+    re-homing path decodes whole objects and re-writes them through
+    the child primary's EC write (the reference's split machinery is
+    pool-type-agnostic too, src/osd/OSDMap.cc)."""
 
     NAME = "pg_autoscaler"
     TICK_EVERY = 1.0
@@ -469,11 +472,7 @@ class PgAutoscalerModule(MgrModule):
         m = self.get("osd_map")
         if m is None:
             return
-        from ..crush.types import PG_POOL_TYPE_ERASURE
-
         for pid, pool in list(m.pools.items()):
-            if pool.type == PG_POOL_TYPE_ERASURE:
-                continue
             ideal = self._ideal(m, pool)
             name = m.pool_names.get(pid, str(pid))
             if ideal > pool.pg_num:

@@ -958,12 +958,6 @@ def _cmd_pool_set(mon: Monitor, cmd: dict) -> MMonCommandReply:
         )
     if val == pool.pg_num:
         return MMonCommandReply(rc=0, outs="no change")
-    if pool.type == PG_POOL_TYPE_ERASURE:
-        return MMonCommandReply(
-            rc=-95,
-            outs="pg_num change on erasure pools unsupported "
-            "(-EOPNOTSUPP)",
-        )
     if pool.snap_seq or getattr(pool, "snaps", None):
         # splitting migrates heads through the client op path; snap
         # clones have no such path and would strand in the parent

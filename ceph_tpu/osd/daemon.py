@@ -488,7 +488,6 @@ class OSD(Dispatcher):
                             pg.repop_clean = False
                     if (
                         pg.state == "active"
-                        and not self._is_ec(pg)
                         and self._pg_num_grew(pg)
                     ):
                         # pg_num grew: re-home objects whose
@@ -2531,7 +2530,9 @@ class OSD(Dispatcher):
                 continue
             try:
                 self._migrate_object(pg, epoch, oid, store_oid, target)
-            except (StoreError, MessageError, OSError):
+            except (
+                StoreError, MessageError, OSError, ErasureCodeError
+            ):
                 failed += 1  # keep going; a later pass rescans
         if failed == 0:
             # only a complete pass advances the split watermark
@@ -2540,7 +2541,22 @@ class OSD(Dispatcher):
     def _migrate_object(
         self, pg: PG, epoch: int, oid: str, store_oid: str, target: str
     ) -> None:
-        data = self.store.read(pg.cid, store_oid)
+        if self._child_has_object(pg, oid, target):
+            # the child already holds this object: either a client on
+            # the new map wrote a NEWER version there (shipping our
+            # pre-split copy would silently revert it) or an earlier
+            # migration pass completed the write.  Either way the
+            # child copy is authoritative — just retire the parent's.
+            self._split_delete_parent(pg, oid, store_oid)
+            return
+        if self._is_ec(pg):
+            # the local store holds only THIS osd's shard: decode the
+            # whole object across the acting set, then ship it through
+            # the child primary's normal EC write path — shards
+            # re-home positionally under the child's acting set
+            data = bytes(self._ec_store_for(pg).get(store_oid))
+        else:
+            data = self.store.read(pg.cid, store_oid)
         xattrs = {
             k: v
             for k, v in self.store.list_attrs(pg.cid, store_oid).items()
@@ -2593,6 +2609,44 @@ class OSD(Dispatcher):
                     if time.monotonic() > deadline:
                         raise
                     time.sleep(0.2)
+        self._split_delete_parent(pg, oid, store_oid)
+
+    def _child_has_object(self, pg: PG, oid: str, target: str) -> bool:
+        """STAT the child through its primary's op path — the
+        guard against reverting a post-split client write with the
+        parent's stale copy."""
+        ps = int(target.split(".")[1])
+        osdmap = self.monc.osdmap
+        _u, _up, _acting, primary = osdmap.pg_to_up_acting_osds(
+            pg.pool_id, ps
+        )
+        msg = MOSDOp(
+            pool=pg.pool_id, pgid=target, oid=oid, op=OSD_OP_STAT,
+            length=-1, reqid=f"split.{pg.pgid}.{oid}.stat",
+            epoch=osdmap.epoch,
+        )
+        try:
+            if primary == self.whoami:
+                tpg = self.pgs.get(target)
+                if tpg is None or tpg.state != "active":
+                    return False
+                if self._is_ec(tpg):
+                    try:
+                        self._ec_store_for(tpg).size(
+                            OBJ_PREFIX + oid
+                        )
+                        return True
+                    except (StoreError, ErasureCodeError):
+                        return False
+                return self.store.exists(tpg.cid, OBJ_PREFIX + oid)
+            reply = self._peer_conn(primary).call(msg, timeout=5.0)
+            return bool(getattr(reply, "ok", False))
+        except (MessageError, OSError, StoreError):
+            return False
+
+    def _split_delete_parent(
+        self, pg: PG, oid: str, store_oid: str
+    ) -> None:
         # logged local delete: replicas of the PARENT drop it too.
         # Current epoch, not the enqueue-time one — a stale epoch
         # would log a non-monotonic version that peering could judge
