@@ -1,0 +1,155 @@
+"""ceph-tpu-cluster — the vstart-analog launcher (src/vstart.sh:1;
+VERDICT round-4 ask #9).
+
+The proofs: one command stands up mon+mgr+OSDs+MDS+RGW in a real
+subprocess; the rados/fs/HTTP surfaces work against it; status/stop
+manage it from outside; a BlockStore-backed cluster restarts with
+its objects intact."""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO)
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def _cluster(args):
+    return subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.tools.cluster", *args],
+        capture_output=True, text=True, env=_env(), timeout=120,
+        cwd=str(REPO),
+    )
+
+
+def _wait_stopped(d: pathlib.Path, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if not (d / "cluster.json").exists():
+            return
+        time.sleep(0.2)
+    raise AssertionError("cluster never stopped")
+
+
+def test_full_stack_cluster_lifecycle(tmp_path):
+    d = tmp_path / "c1"
+    r = _cluster([
+        "start", "--osds", "3", "--mds", "1", "--rgw", "1",
+        "--memstore", "-D", "-d", str(d),
+    ])
+    assert r.returncode == 0, r.stderr
+    conf = json.loads(r.stdout)
+    try:
+        assert conf["osds"] == 3 and conf["mds"] == 1
+        mon_addr = tuple(conf["mon_addr"])
+
+        # status from OUTSIDE the launcher process
+        st = _cluster(["status", "-d", str(d)])
+        assert st.returncode == 0, st.stderr
+        status = json.loads(st.stdout)
+        assert status["num_up_osds"] == 3
+
+        # the rados surface works against it
+        from ceph_tpu.rados import Rados
+
+        cl = Rados("launch-test").connect(*mon_addr)
+        try:
+            cl.pool_create("apppool", pg_num=4)
+            io = cl.open_ioctx("apppool")
+            io.write_full("hello", b"from the launcher")
+            assert io.read("hello") == b"from the launcher"
+
+            # the fs surface (through the launcher's MDS)
+            from ceph_tpu.mds import MDSClient
+
+            fs = MDSClient(cl, "fsdata", name="lt")
+            fs.mkdir("/proof")
+            fs.create("/proof/file")
+            fs.write("/proof/file", 0, b"mds works")
+            assert fs.read("/proof/file") == b"mds works"
+            assert fs.readdir("/proof") == ["file"]
+            fs.close()
+
+            # the S3 surface (through the launcher's RGW)
+            base = f"http://127.0.0.1:{conf['rgw_port']}"
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"{base}/lbucket", method="PUT"
+                ), timeout=10,
+            )
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"{base}/lbucket/obj", data=b"s3 works",
+                    method="PUT",
+                ), timeout=10,
+            )
+            got = urllib.request.urlopen(
+                f"{base}/lbucket/obj", timeout=10
+            ).read()
+            assert got == b"s3 works"
+        finally:
+            cl.shutdown()
+    finally:
+        stop = _cluster(["stop", "-d", str(d)])
+        assert stop.returncode == 0, stop.stderr
+        _wait_stopped(d)
+
+
+def test_blockstore_cluster_survives_restart(tmp_path):
+    d = tmp_path / "c2"
+    r = _cluster([
+        "start", "--osds", "2", "-D", "-d", str(d),
+    ])
+    assert r.returncode == 0, r.stderr
+    conf = json.loads(r.stdout)
+    from ceph_tpu.rados import Rados
+
+    try:
+        cl = Rados("persist-a").connect(*tuple(conf["mon_addr"]))
+        try:
+            cl.pool_create("keep", pg_num=2, size=2)
+            io = cl.open_ioctx("keep")
+            io.write_full("durable", b"survives restart")
+        finally:
+            cl.shutdown()
+    finally:
+        assert _cluster(["stop", "-d", str(d)]).returncode == 0
+        _wait_stopped(d)
+
+    # restart from the same directory: map chain + object data replay
+    r2 = _cluster(["start", "--osds", "2", "-D", "-d", str(d)])
+    assert r2.returncode == 0, r2.stderr
+    conf2 = json.loads(r2.stdout)
+    try:
+        cl = Rados("persist-b").connect(*tuple(conf2["mon_addr"]))
+        try:
+            io = cl.open_ioctx("keep")  # pool survived the restart
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                try:
+                    assert io.read("durable") == b"survives restart"
+                    break
+                except Exception:
+                    time.sleep(0.5)
+            else:
+                raise AssertionError("object lost across restart")
+        finally:
+            cl.shutdown()
+    finally:
+        assert _cluster(["stop", "-d", str(d)]).returncode == 0
+        _wait_stopped(d)
