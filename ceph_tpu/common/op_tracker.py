@@ -16,10 +16,16 @@ from collections import deque
 
 
 class TrackedOp:
-    def __init__(self, tracker: "OpTracker", description: str):
+    def __init__(
+        self, tracker: "OpTracker", description: str, trace: str = ""
+    ):
         self._tracker = tracker
         self.seq = next(tracker._seq)
         self.description = description
+        # the span/trace id (blkin/ZTracer role): the client's reqid,
+        # carried by every sub-op, so dump_historic_ops on DIFFERENT
+        # daemons correlates one logical op end-to-end
+        self.trace = trace
         self.initiated_at = time.time()
         self.events: list[tuple[float, str]] = []
         self._done = False
@@ -51,6 +57,7 @@ class TrackedOp:
         return {
             "seq": self.seq,
             "description": self.description,
+            "trace": self.trace,
             "initiated_at": self.initiated_at,
             "duration": self.duration,
             "type_data": {
@@ -73,8 +80,8 @@ class OpTracker:
         self.history_size = history_size
         self.history_duration = history_duration
 
-    def create_op(self, description: str) -> TrackedOp:
-        op = TrackedOp(self, description)
+    def create_op(self, description: str, trace: str = "") -> TrackedOp:
+        op = TrackedOp(self, description, trace)
         with self._lock:
             self._inflight[op.seq] = op
         return op

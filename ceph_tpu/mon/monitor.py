@@ -37,7 +37,7 @@ from ..msg.message import (
     MOSDFailure,
 )
 from ..msg.messenger import Connection, Dispatcher
-from ..crush.types import PG_POOL_TYPE_ERASURE
+from ..crush.types import PG_POOL_TYPE_ERASURE, PG_POOL_TYPE_REPLICATED
 from ..osd.failure import FailureAggregator
 from ..osd.osdmap import Incremental, OSDMap, PgPool
 from ..store.objectstore import MemStore, ObjectStore, StoreError, Transaction
@@ -915,6 +915,104 @@ def _cmd_mds_fail(mon: Monitor, cmd: dict) -> MMonCommandReply:
     )
 
 
+def _pool_by_name(mon: Monitor, name: str):
+    for pid, pname in mon.osdmap.pool_names.items():
+        if pname == name:
+            return pid, mon.osdmap.pools[pid]
+    return None, None
+
+
+def _tier_commit(mon: Monitor, *pools) -> int:
+    inc = mon.pending()
+    for pid, newp in pools:
+        newp.last_change = mon.osdmap.epoch + 1
+        inc.new_pools[pid] = newp
+    return mon.commit(inc)
+
+
+def _cmd_osd_tier(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """Cache-tier pool wiring (OSDMonitor's "osd tier add /
+    cache-mode / set-overlay / remove-overlay / remove" commands,
+    src/mon/OSDMonitor.cc): a CACHE pool fronts a BASE pool; once the
+    overlay is set, clients route the base pool's ops to the cache
+    (Objecter's read_tier/write_tier redirection)."""
+    import copy as _copy
+
+    op = cmd["tierop"]
+    bid, base = _pool_by_name(mon, cmd["pool"])
+    if base is None:
+        return MMonCommandReply(rc=-2, outs=f"no pool {cmd['pool']!r}")
+    if op in ("add", "remove", "cache-mode", "set-overlay"):
+        cid_, cache = _pool_by_name(mon, cmd["tierpool"])
+        if cache is None:
+            return MMonCommandReply(
+                rc=-2, outs=f"no pool {cmd['tierpool']!r}"
+            )
+    if op == "add":
+        if cache.type != PG_POOL_TYPE_REPLICATED:
+            return MMonCommandReply(
+                rc=-22, outs="cache tier must be replicated (-EINVAL)"
+            )
+        if base.type != PG_POOL_TYPE_REPLICATED:
+            # deviation: the promote path pulls whole objects via the
+            # replicated recovery machinery; an EC base would need
+            # per-shard reconstruction on fetch (reject loudly rather
+            # than silently -ENOENT every cold read)
+            return MMonCommandReply(
+                rc=-22,
+                outs="tiering over an erasure base pool unsupported "
+                "(-EINVAL)",
+            )
+        nc = _copy.deepcopy(cache)
+        nc.tier_of = bid
+        epoch = _tier_commit(mon, (cid_, nc))
+    elif op == "cache-mode":
+        mode = cmd.get("mode", "writeback")
+        if mode not in ("writeback", "none"):
+            return MMonCommandReply(rc=-22, outs=f"bad mode {mode!r}")
+        if mode == "none" and any(
+            p.read_tier == cid_ or p.write_tier == cid_
+            for p in mon.osdmap.pools.values()
+        ):
+            # disabling tiering under a live overlay would strand
+            # redirected writes in the cache pool (real Ceph: -EBUSY)
+            return MMonCommandReply(
+                rc=-16, outs="remove the overlay first (-EBUSY)"
+            )
+        nc = _copy.deepcopy(cache)
+        nc.cache_mode = "" if mode == "none" else mode
+        epoch = _tier_commit(mon, (cid_, nc))
+    elif op == "set-overlay":
+        if cache.tier_of != bid:
+            return MMonCommandReply(
+                rc=-22,
+                outs=f"{cmd['tierpool']} is not a tier of {cmd['pool']}",
+            )
+        nb = _copy.deepcopy(base)
+        nb.read_tier = cid_
+        nb.write_tier = cid_
+        epoch = _tier_commit(mon, (bid, nb))
+    elif op == "remove-overlay":
+        nb = _copy.deepcopy(base)
+        nb.read_tier = -1
+        nb.write_tier = -1
+        epoch = _tier_commit(mon, (bid, nb))
+    elif op == "remove":
+        if base.read_tier == cid_:
+            return MMonCommandReply(
+                rc=-16, outs="remove the overlay first (-EBUSY)"
+            )
+        nc = _copy.deepcopy(cache)
+        nc.tier_of = -1
+        nc.cache_mode = ""
+        epoch = _tier_commit(mon, (cid_, nc))
+    else:
+        return MMonCommandReply(rc=-22, outs=f"bad tierop {op!r}")
+    return MMonCommandReply(
+        rc=0, outb=json.dumps({"epoch": epoch})
+    )
+
+
 def _cmd_mgr_beacon(mon: Monitor, cmd: dict) -> MMonCommandReply:
     """MgrMonitor beacon (src/mon/MgrMonitor.cc reduced): one active
     mgr whose address daemons discover to push MMgrReports."""
@@ -948,6 +1046,18 @@ def _cmd_pool_set(mon: Monitor, cmd: dict) -> MMonCommandReply:
             break
     if pool_id is None:
         return MMonCommandReply(rc=-2, outs=f"no pool {name!r} (-ENOENT)")
+    if var == "target_max_objects":
+        import copy as _copy
+
+        newp = _copy.deepcopy(mon.osdmap.pools[pool_id])
+        newp.target_max_objects = int(cmd["val"])
+        newp.last_change = mon.osdmap.epoch + 1
+        inc = mon.pending()
+        inc.new_pools[pool_id] = newp
+        epoch = mon.commit(inc)
+        return MMonCommandReply(
+            rc=0, outb=json.dumps({"epoch": epoch})
+        )
     if var != "pg_num":
         return MMonCommandReply(rc=-22, outs=f"cannot set {var!r} (-EINVAL)")
     val = int(cmd["val"])
@@ -1054,6 +1164,7 @@ _COMMANDS = {
     "mgr beacon": _cmd_mgr_beacon,
     "mgr stat": _cmd_mgr_stat,
     "osd pool set": _cmd_pool_set,
+    "osd tier": _cmd_osd_tier,
     "osd pool selfmanaged-snap create": _cmd_sm_snap_create,
     "osd pool selfmanaged-snap rm": _cmd_sm_snap_rm,
 }

@@ -143,15 +143,18 @@ class MECSubWrite(Message):
     from_osd: int = 0
     epoch: int = 0
     txn: Transaction = field(default_factory=Transaction)
+    trace: str = ""  # span id (ECBackend.cc:886: sub-ops carry trace)
 
     def encode_payload(self, e: Encoder) -> None:
         e.s32(self.from_osd).u32(self.epoch)
         encode_transaction(e, self.txn)
+        e.string(self.trace)
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MECSubWrite":
         return cls(
-            from_osd=d.s32(), epoch=d.u32(), txn=decode_transaction(d)
+            from_osd=d.s32(), epoch=d.u32(),
+            txn=decode_transaction(d), trace=d.string(),
         )
 
 
@@ -454,17 +457,20 @@ class MOSDRepOp(Message):
     epoch: int = 0
     txn: "Transaction" = None  # type: ignore[assignment]
     entry_blob: bytes = b""  # encoded LogEntry
+    trace: str = ""  # span id (the client reqid; ECBackend.cc:886 role)
 
     def encode_payload(self, e: Encoder) -> None:
         e.string(self.pgid).u32(self.epoch)
         encode_transaction(e, self.txn)
         e.bytes(self.entry_blob)
+        e.string(self.trace)
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MOSDRepOp":
         return cls(
             pgid=d.string(), epoch=d.u32(),
             txn=decode_transaction(d), entry_blob=d.bytes(),
+            trace=d.string(),
         )
 
 

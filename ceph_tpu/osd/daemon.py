@@ -145,6 +145,10 @@ from .pg_log import (
 PG_META = "_pgmeta_"
 LOG_PREFIX = "_log/"
 OBJ_PREFIX = "o_"
+# cache-tier object state attr (object_info_t dirty flag role): set
+# by every client mutation on a writeback cache pool, cleared (value
+# b"0") after the agent flushes the object to the base pool
+TIER_DIRTY = "t_dirty"
 INFO_ATTR = "pginfo"
 # snapshots: clones are stored as "<OBJ_PREFIX><oid>@<snapid>" (the
 # clone-object naming of hobject_t snaps); "@" is reserved in oids.
@@ -242,6 +246,7 @@ class OSD(Dispatcher):
         heartbeat_grace: float = 2.0,
         scrub_interval: float = 0.0,
         max_backfills: int = 2,
+        admin_socket_path: str | None = None,
         client_message_cap: int = 256 << 20,
         op_queue: str = "wpq",
     ):
@@ -290,7 +295,23 @@ class OSD(Dispatcher):
         # delegate answering MECSubRead/MECSubWrite from our store
         # (the handle_sub_read/handle_sub_write role)
         self._ec_codecs: dict[tuple, ECCodec] = {}
-        self._shard_server = ShardServer(self.store, whoami)
+        # op tracking with span ids (TrackedOp/OpTracker + the
+        # blkin/ZTracer seat): every client op registers under its
+        # reqid; every sub-op carries that reqid as its trace, so
+        # dump_historic_ops on two daemons correlates one op
+        from ..common import AdminSocket, OpTracker
+
+        self.op_tracker = OpTracker()
+        self.admin = None
+        if admin_socket_path:
+            self.admin = AdminSocket(
+                str(admin_socket_path), perf=None
+            )
+            self.op_tracker.register_admin_commands(self.admin)
+            self.admin.start()
+        self._shard_server = ShardServer(
+            self.store, whoami, tracker=self.op_tracker
+        )
         # watch/notify (PrimaryLogPG watchers + Notify machinery):
         # watchers are in-memory per primary — clients re-register via
         # Objecter linger on every new interval (documented deviation
@@ -314,6 +335,8 @@ class OSD(Dispatcher):
             .add_time_avg("op_latency", "client op latency")
             .add_u64_gauge("numpg", "hosted pgs")
             .add_u64_gauge("recovery_active", "in-flight recovery pushes")
+            .add_u64_counter("tier_flush", "cache-tier agent flushes")
+            .add_u64_counter("tier_evict", "cache-tier agent evictions")
             .create_perf_counters()
         )
         self._mgr_addr: str | None = None
@@ -322,6 +345,7 @@ class OSD(Dispatcher):
         self._splitting: set[str] = set()
         self._recovery_lock = lockdep.Mutex("osd.recovery")
         self._scrubbing: set[str] = set()
+        self._tier_running: set[str] = set()
         # async recovery through the scheduler (VERDICT r4 ask #7):
         # in-flight per-(pg, peer) recovery ops, gated by a TWO-SIDED
         # reservation — the local reserver caps how many recoveries
@@ -342,6 +366,7 @@ class OSD(Dispatcher):
         # peers this OSD has filed failure reports for (to withdraw
         # with failed_for=-1 when they speak again — send_still_alive)
         self._reported: set[int] = set()
+        self._cur_op = None  # worker-thread-current TrackedOp
         # last seen up/down per peer, to reset heartbeat stamps on a
         # down→up transition (a stale stamp would re-report instantly)
         self._last_up: dict[int, bool] = {}
@@ -385,6 +410,8 @@ class OSD(Dispatcher):
         self._workq.put(None)
         if self._worker is not None:
             self._worker.join(timeout=5)
+        if self.admin is not None:
+            self.admin.stop()
         self.messenger.shutdown()
 
     # -- map / PG walk -----------------------------------------------------
@@ -1013,9 +1040,17 @@ class OSD(Dispatcher):
     # -- client op path (primary) ------------------------------------------
     def _handle_op(self, conn: Connection, msg: MOSDOp) -> None:
         t0 = time.perf_counter()
+        top = self.op_tracker.create_op(
+            f"osd_op({msg.reqid} {msg.pgid} {msg.oid} op={msg.op})",
+            trace=msg.reqid,
+        )
+        top.mark_event("started")
+        self._cur_op = top
         try:
             self._handle_op_inner(conn, msg)
         finally:
+            self._cur_op = None
+            top.finish()
             self.perf.inc("op")
             if msg.op in (
                 OSD_OP_READ, OSD_OP_STAT, OSD_OP_GETXATTR,
@@ -1069,7 +1104,15 @@ class OSD(Dispatcher):
             return
         store_oid = OBJ_PREFIX + msg.oid
         is_ec = self._is_ec(pg)
+        tiered = (
+            pool is not None
+            and pool.tier_of >= 0
+            and pool.cache_mode == "writeback"
+            and not is_ec
+        )
         try:
+            if tiered and not msg.reqid.startswith("tier-"):
+                self._tier_front(pg, pool, epoch, msg, store_oid)
             if msg.op in (
                 OSD_OP_READ, OSD_OP_STAT, OSD_OP_GETXATTR,
                 OSD_OP_OMAPGET,
@@ -1138,6 +1181,19 @@ class OSD(Dispatcher):
                 )
             else:
                 self._mutate(pg, epoch, msg, store_oid)
+                if (
+                    tiered
+                    and msg.op == OSD_OP_DELETE
+                    and not msg.reqid.startswith("tier-")
+                ):
+                    # writeback deletes propagate to the base
+                    # SYNCHRONOUSLY (deviation from the reference's
+                    # whiteout objects — correctness over latency)
+                    self._tier_base_op(
+                        pool, msg.oid, OSD_OP_DELETE,
+                        reqid=f"tier-del.{msg.reqid}",
+                        ignore_enoent=True,
+                    )
         except (StoreError, ClassError, ErasureCodeError) as e:
             reply.ok = False
             reply.error = str(e)
@@ -1631,6 +1687,19 @@ class OSD(Dispatcher):
                 pg.cid, store_oid, BORN_ATTR,
                 str(pool.snap_seq if pool else 0).encode(),
             )
+        tpool = self._pool_of(pg)
+        if (
+            tpool is not None
+            and tpool.tier_of >= 0
+            and tpool.cache_mode == "writeback"
+            and msg.op != OSD_OP_DELETE
+            and not (ctx is not None and ctx.removed)
+            and not msg.reqid.startswith("tier-")
+        ):
+            # writeback bookkeeping (maybe_handle_cache_detail's
+            # dirty tracking): the agent flushes b"1" objects to the
+            # base pool; internal tier- ops (promotions) stay clean
+            txn.setattr(pg.cid, store_oid, TIER_DIRTY, b"1")
         txn_by_osd = {
             osd: txn
             for osd in pg.acting
@@ -1685,16 +1754,22 @@ class OSD(Dispatcher):
         for osd, txn in txn_by_osd.items():
             if osd == self.whoami:
                 continue
+            if self._cur_op is not None:
+                self._cur_op.mark_event(f"sub_op_sent osd.{osd}")
             try:
                 ack = self._peer_conn(osd).call(
                     MOSDRepOp(
                         pgid=pg.pgid, epoch=epoch, txn=txn,
-                        entry_blob=entry_blob,
+                        entry_blob=entry_blob, trace=msg.reqid,
                     ),
                     timeout=10.0,
                 )
                 if isinstance(ack, MOSDRepOpReply) and not ack.ok:
                     failed.append(osd)
+                elif self._cur_op is not None:
+                    self._cur_op.mark_event(
+                        f"sub_op_commit_rec osd.{osd}"
+                    )
             except (MessageError, OSError):
                 failed.append(osd)
         live_failures = [
@@ -1959,12 +2034,17 @@ class OSD(Dispatcher):
     def _handle_rep_op(self, conn: Connection, msg: MOSDRepOp) -> None:
         pg = self.pgs.get(msg.pgid)
         reply = MOSDRepOpReply(tid=msg.tid, from_osd=self.whoami)
+        top = self.op_tracker.create_op(
+            f"rep_op({msg.trace} {msg.pgid})", trace=msg.trace
+        )
         if pg is None or pg.activated_epoch == 0:
             # an unactivated replica must not splice mid-stream
             # entries into an empty log (its hole-filled log could
             # later win find_best_info's tie-break)
             reply.ok = False
             reply.error = "pg not activated (-EAGAIN)"
+            top.mark_event("rejected: pg not activated")
+            top.finish()
             conn.send(reply)
             return
         try:
@@ -1980,6 +2060,8 @@ class OSD(Dispatcher):
         except StoreError as e:
             reply.ok = False
             reply.error = str(e)
+        top.mark_event("applied" if reply.ok else "failed")
+        top.finish()
         conn.send(reply)
 
     def _handle_query(self, conn: Connection, msg: MPGQuery) -> None:
@@ -2396,6 +2478,13 @@ class OSD(Dispatcher):
                         fut.set_result(fn())
                     except Exception as e:  # noqa: BLE001
                         fut.set_exception(e)
+                elif kind == "tier_agent":
+                    pg = self.pgs.get(item[1])
+                    try:
+                        if pg is not None:
+                            self._tier_agent(pg)
+                    finally:
+                        self._tier_running.discard(item[1])
                 elif kind == "scrub":
                     pg = self.pgs.get(item[1])
                     try:
@@ -2661,6 +2750,246 @@ class OSD(Dispatcher):
             lambda: self._mutate(pg, cur_epoch, del_msg, store_oid)
         )
 
+    # -- cache tiering (PrimaryLogPG maybe_handle_cache_detail +
+    # TierAgentState, src/osd/PrimaryLogPG.cc:2492,2215 reduced) ------------
+    def _tier_front(
+        self, pg: PG, pool, epoch: int, msg: MOSDOp, store_oid: str
+    ) -> None:
+        """Cache-pool front end for one client op: record recency and
+        PROMOTE the object from the base pool when the op needs its
+        prior state and the cache misses (promote_object's role).
+        WRITEFULL/DELETE overwrite wholesale — no promote needed."""
+        atime = getattr(pg, "tier_atime", None)
+        if atime is None:
+            atime = pg.tier_atime = {}
+        atime[msg.oid] = time.monotonic()
+        if msg.op in (OSD_OP_WRITEFULL, OSD_OP_DELETE):
+            return
+        if self.store.exists(pg.cid, store_oid):
+            return
+        self._tier_promote(pg, pool, epoch, msg.oid)
+
+    def _tier_promote(self, pg: PG, pool, epoch: int, oid: str) -> None:
+        """Copy (data + user attrs + omap) up from the base pool into
+        the cache pg through the normal logged/replicated write path;
+        the promoted copy is CLEAN (tier- reqids skip dirty marking).
+        A base miss is simply a cache miss (the op then sees -ENOENT
+        exactly as it should)."""
+        push = self._tier_base_fetch(pool, epoch, oid)
+        if push is None or not push.exists:
+            return
+        rq = f"tier-promote.{pg.pgid}.{oid}"
+        self._mutate(pg, epoch, MOSDOp(
+            pool=pg.pool_id, pgid=pg.pgid, oid=oid,
+            op=OSD_OP_WRITEFULL, data=push.data, length=-1,
+            reqid=rq + ".d", epoch=self.monc.epoch,
+        ), OBJ_PREFIX + oid)
+        for name, val in sorted(push.attrs.items()):
+            if name.startswith("u_"):
+                self._mutate(pg, epoch, MOSDOp(
+                    pool=pg.pool_id, pgid=pg.pgid, oid=oid,
+                    op=OSD_OP_SETXATTR, attr=name[2:], data=val,
+                    length=-1, reqid=f"{rq}.x.{name}",
+                    epoch=self.monc.epoch,
+                ), OBJ_PREFIX + oid)
+        if push.omap:
+            e = Encoder()
+            e.map(
+                push.omap,
+                lambda e2, k: e2.string(k),
+                lambda e2, v: e2.bytes(v),
+            )
+            self._mutate(pg, epoch, MOSDOp(
+                pool=pg.pool_id, pgid=pg.pgid, oid=oid,
+                op=OSD_OP_OMAPSET, data=e.getvalue(), length=-1,
+                reqid=rq + ".o", epoch=self.monc.epoch,
+            ), OBJ_PREFIX + oid)
+
+    def _tier_base_target(self, pool, oid: str):
+        """(base_pool, base_pgid, primary) for an object's base copy."""
+        from ..osdc.objecter import object_to_pg
+
+        base = self.monc.osdmap.pools.get(pool.tier_of)
+        if base is None:
+            raise StoreError(f"tier base pool {pool.tier_of} gone")
+        pgid = object_to_pg(base, oid)
+        ps = int(pgid.split(".")[1])
+        _u, _up, _a, primary = self.monc.osdmap.pg_to_up_acting_osds(
+            base.pool_id, ps
+        )
+        return base, pgid, primary
+
+    def _tier_base_fetch(self, pool, epoch: int, oid: str):
+        """Whole object (data+attrs+omap) from the base primary — the
+        recovery pull machinery doubles as copy-up (copy_from role)."""
+        base, pgid, primary = self._tier_base_target(pool, oid)
+        if primary == self.whoami:
+            bpg = self.pgs.get(pgid)
+            if bpg is None:
+                return None
+            return self._push_for(bpg, epoch, oid)
+        try:
+            reply = self._peer_conn(primary).call(
+                MPGPull(
+                    pgid=pgid, epoch=epoch, oid=oid, shard=-1
+                ),
+                timeout=10.0,
+            )
+        except (MessageError, OSError) as e:
+            raise StoreError(f"tier base fetch failed: {e} (-EAGAIN)")
+        return reply if isinstance(reply, MPGPush) else None
+
+    def _tier_base_op(
+        self,
+        pool,
+        oid: str,
+        op: int,
+        data: bytes = b"",
+        attr: str = "",
+        reqid: str = "",
+        ignore_enoent: bool = False,
+    ) -> None:
+        """One op against the base pool's primary (flush/delete
+        propagation), targeted DIRECTLY at the base pgid so the
+        overlay redirection cannot bounce it back to us."""
+        base, pgid, primary = self._tier_base_target(pool, oid)
+        msg = MOSDOp(
+            pool=base.pool_id, pgid=pgid, oid=oid, op=op, data=data,
+            attr=attr, length=-1, reqid=reqid,
+            epoch=self.monc.epoch,
+        )
+        if primary == self.whoami:
+            bpg = self.pgs.get(pgid)
+            if bpg is None or bpg.state != "active":
+                raise StoreError("base pg not active (-EAGAIN)")
+            try:
+                self._mutate(bpg, self.monc.epoch, msg, OBJ_PREFIX + oid)
+            except StoreError as e:
+                if not (ignore_enoent and "ENOENT" in str(e)):
+                    raise
+            return
+        try:
+            reply = self._peer_conn(primary).call(msg, timeout=10.0)
+        except (MessageError, OSError) as e:
+            raise StoreError(f"tier base op failed: {e} (-EAGAIN)")
+        if not getattr(reply, "ok", False):
+            err = getattr(reply, "error", "nak")
+            if not (ignore_enoent and "ENOENT" in err):
+                raise StoreError(err)
+
+    def _tier_agent(self, pg: PG) -> None:
+        """One agent pass over a cache pg (TierAgentState flush/evict
+        modes): flush every dirty object to the base pool, then evict
+        the least-recently-used CLEAN objects down to the pool's
+        per-pg share of target_max_objects.  A lost clean-marker
+        (failover) merely causes an idempotent re-flush."""
+        pool = self._pool_of(pg)
+        if (
+            pool is None or pool.tier_of < 0
+            or pool.cache_mode != "writeback"
+            or pg.primary != self.whoami or pg.state != "active"
+        ):
+            return
+        try:
+            oids = [
+                o for o in self.store.list_objects(pg.cid)
+                if o.startswith(OBJ_PREFIX) and "@" not in o
+            ]
+        except StoreError:
+            return
+        atime = getattr(pg, "tier_atime", {})
+        for store_oid in oids:
+            oid = store_oid[len(OBJ_PREFIX):]
+            try:
+                dirty = self.store.getattr(
+                    pg.cid, store_oid, TIER_DIRTY
+                ) == b"1"
+            except StoreError:
+                dirty = False
+            if not dirty:
+                continue
+            try:
+                self._tier_flush_object(pg, pool, oid, store_oid)
+                self.perf.inc("tier_flush")
+            except (StoreError, MessageError, OSError):
+                pass  # next pass retries
+        if pool.target_max_objects <= 0:
+            return
+        budget = max(1, pool.target_max_objects // max(pool.pg_num, 1))
+        live = [
+            o for o in oids
+            if self.store.exists(pg.cid, o)
+        ]
+        if len(live) <= budget:
+            return
+        # evict clean LRU first (hit-set recency, in-memory deviation)
+        def last_access(store_oid):
+            return atime.get(store_oid[len(OBJ_PREFIX):], 0.0)
+
+        for store_oid in sorted(live, key=last_access):
+            if len(live) <= budget:
+                break
+            try:
+                if self.store.getattr(
+                    pg.cid, store_oid, TIER_DIRTY
+                ) == b"1":
+                    continue  # never evict unflushed data
+            except StoreError:
+                pass
+            oid = store_oid[len(OBJ_PREFIX):]
+            try:
+                self._mutate(pg, self.monc.epoch, MOSDOp(
+                    pool=pg.pool_id, pgid=pg.pgid, oid=oid,
+                    op=OSD_OP_DELETE, length=-1,
+                    reqid=f"tier-evict.{pg.pgid}.{oid}",
+                    epoch=self.monc.epoch,
+                ), store_oid)
+                live.remove(store_oid)
+                atime.pop(oid, None)
+                self.perf.inc("tier_evict")
+            except StoreError:
+                pass
+
+    def _tier_flush_object(
+        self, pg: PG, pool, oid: str, store_oid: str
+    ) -> None:
+        """Write the cache copy back to the base pool (agent flush),
+        then mark it clean — locally only: the clean bit is an
+        optimization; a replica's stale dirty bit after failover just
+        re-flushes idempotently."""
+        data = self.store.read(pg.cid, store_oid)
+        attrs = self.store.list_attrs(pg.cid, store_oid)
+        omap = self.store.omap_get(pg.cid, store_oid)
+        rq = f"tier-flush.{pg.pgid}.{oid}"
+        self._tier_base_op(
+            pool, oid, OSD_OP_WRITEFULL, data=data, reqid=rq + ".d"
+        )
+        for name, val in sorted(attrs.items()):
+            if name.startswith("u_"):
+                self._tier_base_op(
+                    pool, oid, OSD_OP_SETXATTR, data=val,
+                    attr=name[2:], reqid=f"{rq}.x.{name}",
+                )
+        if omap:
+            e = Encoder()
+            e.map(
+                omap,
+                lambda e2, k: e2.string(k),
+                lambda e2, v: e2.bytes(v),
+            )
+            self._tier_base_op(
+                pool, oid, OSD_OP_OMAPSET, data=e.getvalue(),
+                reqid=rq + ".o",
+            )
+        try:
+            self.store.queue_transaction(
+                Transaction().setattr(
+                    pg.cid, store_oid, TIER_DIRTY, b"0"
+                )
+            )
+        except StoreError:
+            pass
+
     def _tick_loop(self) -> None:
         while not self._stop.wait(self.tick_interval):
             now = time.monotonic()
@@ -2696,6 +3025,26 @@ class OSD(Dispatcher):
                     self._workq.enqueue(
                         CLASS_BACKGROUND, 1, ("scrub", pgid)
                     )
+            # cache-tier agent (TierAgentState flush/evict, scheduled
+            # like scrub, executed on the worker off the tick thread)
+            with self._pg_lock:
+                tier_due = [
+                    pg.pgid
+                    for pg in self.pgs.values()
+                    if pg.primary == self.whoami
+                    and pg.state == "active"
+                    and pg.pgid not in self._tier_running
+                    and (
+                        (p := self._pool_of(pg)) is not None
+                        and p.tier_of >= 0
+                        and p.cache_mode == "writeback"
+                    )
+                ]
+            for pgid in tier_due:
+                self._tier_running.add(pgid)
+                self._workq.enqueue(
+                    CLASS_BACKGROUND, 1, ("tier_agent", pgid)
+                )
             # mon session failover (MonClient reconnect)
             try:
                 self.monc.ensure_connected()

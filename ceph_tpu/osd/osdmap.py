@@ -82,6 +82,14 @@ class PgPool:
     # snap_seq is the newest snap id, the write path's snap context
     snap_seq: int = 0
     snaps: dict[int, str] = field(default_factory=dict)
+    # cache tiering (pg_pool_t tier fields, src/osd/osd_types.h):
+    # on a BASE pool, read_tier/write_tier name the overlay cache
+    # pool clients route to; on a CACHE pool, tier_of names the base
+    tier_of: int = -1
+    read_tier: int = -1
+    write_tier: int = -1
+    cache_mode: str = ""  # "" | "writeback"
+    target_max_objects: int = 0  # agent eviction pressure point
 
     def __post_init__(self):
         if not self.pgp_num:
@@ -766,6 +774,8 @@ def _enc_pool(e: Encoder, p: PgPool) -> None:
         lambda e2, k: e2.u64(k),
         lambda e2, v: e2.string(v),
     )
+    e.s64(p.tier_of).s64(p.read_tier).s64(p.write_tier)
+    e.string(p.cache_mode).u64(p.target_max_objects)
 
 
 def _dec_pool(d: Decoder) -> PgPool:
@@ -782,6 +792,11 @@ def _dec_pool(d: Decoder) -> PgPool:
         last_change=d.u32(),
         snap_seq=d.u64(),
         snaps=d.map(lambda d2: d2.u64(), lambda d2: d2.string()),
+        tier_of=d.s64(),
+        read_tier=d.s64(),
+        write_tier=d.s64(),
+        cache_mode=d.string(),
+        target_max_objects=d.u64(),
     )
 
 

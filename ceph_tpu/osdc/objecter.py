@@ -97,6 +97,19 @@ class Objecter:
                 pass  # next epoch retries
 
     # -- targeting ---------------------------------------------------------
+    def _resolve_tier(self, pool_id: int, write: bool) -> int:
+        """Cache-tier overlay redirection (Objecter::_calc_target's
+        read_tier/write_tier handling): ops on a BASE pool with an
+        overlay route to the cache pool; the cache primary promotes,
+        proxies and flushes behind the scenes."""
+        pool = self.monc.osdmap.pools.get(pool_id)
+        if pool is None:
+            return pool_id
+        tier = pool.write_tier if write else pool.read_tier
+        if tier >= 0 and tier in self.monc.osdmap.pools:
+            return tier
+        return pool_id
+
     def _target(self, pool_id: int, oid: str) -> tuple[str, int]:
         osdmap = self.monc.osdmap
         pool = osdmap.pools.get(pool_id)
@@ -136,21 +149,44 @@ class Objecter:
         snap_seq: int = 0,
     ) -> MOSDOpReply:
         """Target, send, and retry until acked or timed out."""
+        from ..msg.message import (
+            OSD_OP_GETXATTR,
+            OSD_OP_LIST,
+            OSD_OP_OMAPGET,
+            OSD_OP_READ,
+            OSD_OP_STAT,
+        )
+
+        is_read = op in (
+            OSD_OP_READ, OSD_OP_STAT, OSD_OP_GETXATTR,
+            OSD_OP_OMAPGET, OSD_OP_LIST,
+        )
         deadline = time.monotonic() + self.op_timeout
         last_err = "no attempt"
         reqid = f"{self._client_id}.{next(self._op_seq)}"
         while time.monotonic() < deadline:
             try:
+                # re-resolve the tier overlay every attempt: a map
+                # change may add/remove the cache redirection mid-op
+                # LIST stays on the BASE pool: the cache holds only
+                # resident objects (deviation: objects written but
+                # not yet flushed are invisible to listings until the
+                # agent's next pass)
+                eff_pool = (
+                    self._resolve_tier(pool_id, not is_read)
+                    if pgid is None and op != OSD_OP_LIST
+                    else pool_id
+                )
                 tgt_pgid, primary = (
                     (pgid, self._pg_primary(pgid))
                     if pgid is not None
-                    else self._target(pool_id, oid)
+                    else self._target(eff_pool, oid)
                 )
                 if primary < 0:
                     raise MessageError("pg has no primary (all down?)")
                 reply = self._conn_to(primary).call(
                     MOSDOp(
-                        pool=pool_id, pgid=tgt_pgid, oid=oid, op=op,
+                        pool=eff_pool, pgid=tgt_pgid, oid=oid, op=op,
                         offset=offset, length=length, data=data,
                         attr=attr, reqid=reqid, epoch=self.monc.epoch,
                         snapid=snapid, snap_seq=snap_seq,

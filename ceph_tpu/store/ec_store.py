@@ -137,9 +137,18 @@ class ECStore:
         self.extent_cache = ExtentCache()
 
     # -- write path --------------------------------------------------------
-    def put(self, name: str, data: bytes) -> None:
+    def put(self, name: str, data: bytes, trace: str = "") -> None:
         """Full-object write: pad to stripes, batch encode, one
-        transaction per shard carrying chunk bytes + hinfo."""
+        transaction per shard carrying chunk bytes + hinfo.  When
+        shards are remote (RemoteStore sub-op proxies), ``trace``
+        rides every MECSubWrite so shard daemons record the same
+        span id (ECBackend.cc:886's sub-op tracing)."""
+        from .remote import trace_context
+
+        with trace_context(trace):
+            self._put_inner(name, data)
+
+    def _put_inner(self, name: str, data: bytes) -> None:
         logical = len(data)
         padded_len = self.sinfo.logical_to_next_stripe_offset(logical)
         padded = data + b"\0" * (padded_len - logical)

@@ -21,6 +21,9 @@ by the shard OSD's handle_sub_write (src/osd/ECBackend.cc:2106 fan-out,
 
 from __future__ import annotations
 
+import contextlib
+import threading
+
 import argparse
 import sys
 import time
@@ -49,23 +52,58 @@ from ..msg.messenger import Connection, Dispatcher
 from .objectstore import MemStore, ObjectStore, StoreError, Transaction
 
 
+# ambient span id for sub-ops issued through RemoteStore: set by the
+# caller (the EC daemon path wraps its shard fan-out) so every
+# MECSubWrite carries the client op's trace without threading a
+# parameter through the ObjectStore interface
+_TRACE = threading.local()
+
+
+@contextlib.contextmanager
+def trace_context(trace: str):
+    prev = getattr(_TRACE, "id", "")
+    _TRACE.id = trace
+    try:
+        yield
+    finally:
+        _TRACE.id = prev
+
+
+def current_trace() -> str:
+    return getattr(_TRACE, "id", "")
+
+
 class ShardServer(Dispatcher):
     """Shard-OSD dispatcher: one ObjectStore behind sub-op messages."""
 
-    def __init__(self, store: ObjectStore | None = None, whoami: int = 0):
+    def __init__(
+        self,
+        store: ObjectStore | None = None,
+        whoami: int = 0,
+        tracker=None,
+    ):
         self.store = store or MemStore()
         self.whoami = whoami
+        self.tracker = tracker  # OpTracker: sub-ops record their span
 
     def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
         if isinstance(msg, MECSubWrite):
             reply = MECSubWriteReply(
                 tid=msg.tid, from_osd=self.whoami
             )
+            top = None
+            if self.tracker is not None:
+                top = self.tracker.create_op(
+                    f"ec_sub_write({msg.trace})", trace=msg.trace
+                )
             try:
                 self.store.queue_transaction(msg.txn)
             except StoreError as e:
                 reply.ok = False
                 reply.error = str(e)
+            if top is not None:
+                top.mark_event("applied" if reply.ok else "failed")
+                top.finish()
             conn.send(reply)
             return True
         if isinstance(msg, MECSubRead):
@@ -160,7 +198,10 @@ class RemoteStore(ObjectStore):
 
     # -- write -------------------------------------------------------------
     def queue_transaction(self, txn: Transaction) -> None:
-        reply = self._call(MECSubWrite(txn=txn), MECSubWriteReply)
+        reply = self._call(
+            MECSubWrite(txn=txn, trace=current_trace()),
+            MECSubWriteReply,
+        )
         if not reply.ok:
             raise StoreError(reply.error)
 

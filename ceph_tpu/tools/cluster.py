@@ -104,7 +104,10 @@ class Cluster:
 
         for i in range(n):
             store = self._store_for(i)
-            osd = OSD(i, store=store)
+            osd = OSD(
+                i, store=store,
+                admin_socket_path=str(self.dir / f"osd.{i}.asok"),
+            )
             osd.boot(*mon_addr)
             self.osds.append(osd)
 
@@ -151,7 +154,11 @@ class Cluster:
             conf["rgw_port"] = self.rgw.serve(
                 int(self.spec.get("rgw_port", 0))
             )
-        (self.dir / "cluster.json").write_text(json.dumps(conf))
+        # atomic publish: the daemonize parent polls for this file
+        # and reads it immediately — a partial write would crash it
+        tmp = self.dir / "cluster.json.tmp"
+        tmp.write_text(json.dumps(conf))
+        os.replace(tmp, self.dir / "cluster.json")
         return conf
 
     def _store_for(self, i: int):
@@ -178,6 +185,11 @@ class Cluster:
     def stop(self) -> None:
         if self.rgw is not None:
             self.rgw.shutdown()
+        if self.mgr is not None:
+            try:
+                self.mgr.shutdown()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
         for d in self.mds:
             d.shutdown()
         for osd in self.osds:

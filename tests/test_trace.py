@@ -1,0 +1,102 @@
+"""Span ids through the op path (the blkin/ZTracer role,
+src/osd/ECBackend.cc:886 — every sub-op carries a trace;
+VERDICT round-4 ask #10).
+
+The proof: one client op's reqid shows up in dump_historic_ops on
+BOTH the primary (osd_op span, with sub_op_sent/commit events) and
+the replica (rep_op span) — end-to-end correlation across daemons —
+and the dump is reachable over a real admin socket."""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+
+import pytest
+
+from ceph_tpu.msg.message import OSD_OP_WRITEFULL
+
+from test_osd_daemon import MiniCluster, POOL
+
+
+def _spans(osd, trace):
+    dump = osd.op_tracker.dump_historic_ops()
+    return [op for op in dump["ops"] if op["trace"] == trace]
+
+
+def test_one_op_correlates_across_daemons(tmp_path):
+    c = MiniCluster()
+    try:
+        asok = str(tmp_path / "osd.0.asok")
+        c.start_osd(0, admin_socket_path=asok)
+        for i in (1, 2):
+            c.start_osd(i)
+        c.wait_active()
+        reply = c.op("1.0", "traced", OSD_OP_WRITEFULL, b"follow me")
+        assert reply.ok
+        # recover the reqid the harness stamped (MiniCluster.op uses
+        # test.<seq>); find it from the primary's history instead of
+        # guessing the counter
+        primary = c.primary_of("1.0")
+        posd = c.osds[primary]
+        # the reply ships just before the span finishes into history
+        deadline = time.monotonic() + 5
+        prim_ops = []
+        while time.monotonic() < deadline and not prim_ops:
+            prim_ops = [
+                op
+                for op in posd.op_tracker.dump_historic_ops()["ops"]
+                if "traced" in op["description"]
+            ]
+            if not prim_ops:
+                time.sleep(0.05)
+        assert prim_ops, "primary never tracked the op"
+        span = prim_ops[-1]
+        trace = span["trace"]
+        assert trace.startswith("test."), span
+        events = [e["event"] for e in span["type_data"]["events"]]
+        assert any(e.startswith("sub_op_sent") for e in events), events
+        assert any(
+            e.startswith("sub_op_commit_rec") for e in events
+        ), events
+
+        # the SAME trace id appears on the replicas' rep_op spans —
+        # the cross-daemon correlation the reference gets from ZTracer
+        pg = posd.pgs["1.0"]
+        replicas = [o for o in pg.acting if o != primary]
+        assert replicas
+        for r in replicas:
+            spans = _spans(c.osds[r], trace)
+            assert spans, f"osd.{r} has no span for {trace}"
+            assert spans[-1]["description"].startswith("rep_op(")
+            revents = [
+                e["event"]
+                for e in spans[-1]["type_data"]["events"]
+            ]
+            assert "applied" in revents
+
+        # and the dump is served over the real admin socket when the
+        # osd hosts one (osd.0 here)
+        if primary == 0 or 0 in pg.acting:
+            s = socket.socket(socket.AF_UNIX)
+            s.connect(asok)
+            s.sendall(json.dumps(
+                {"prefix": "dump_historic_ops"}
+            ).encode() + b"\n")
+            buf = b""
+            s.settimeout(5)
+            while True:
+                try:
+                    chunk = s.recv(65536)
+                except socket.timeout:
+                    break
+                if not chunk:
+                    break
+                buf += chunk
+            s.close()
+            out = json.loads(buf)
+            ops = out.get("ok", out).get("ops", [])
+            assert trace in {op.get("trace") for op in ops}, out
+    finally:
+        c.shutdown()
