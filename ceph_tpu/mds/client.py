@@ -282,6 +282,12 @@ class MDSClient(Dispatcher):
                 pst = self._stat_cache.get(parent)
                 if pst is not None:
                     self._dir_cache.pop(pst["ino"], None)
+                else:
+                    # parent ino unknown (its stat was never cached):
+                    # a targeted drop is impossible, and a stale
+                    # parent listing would show the old name — clear
+                    # the dir cache conservatively
+                    self._dir_cache.clear()
 
     def mkdir(self, path: str) -> int:
         out = self._call("mkdir", {"path": path},

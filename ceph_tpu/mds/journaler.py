@@ -119,21 +119,94 @@ class Journaler:
     def replay(self):
         """Yield every committed entry in [expire_pos, write_pos) —
         the standby's journal replay on takeover."""
-        pos = self.expire_pos
+        for entry, _end in self.replay_from(self.expire_pos):
+            yield entry
+
+    # -- registered clients (Journaler client registry role,
+    # src/journal/JournalMetadata.cc: a tailing consumer — rbd-mirror —
+    # records its replay position; trim never passes the slowest
+    # client).  Positions live in a SEPARATE omap object so consumer
+    # updates never race the owner's head writes. ---------------------------
+    def _clients_oid(self) -> str:
+        return f"{self.prefix}.clients"
+
+    def register_client(self, cid: str) -> int:
+        """Idempotent; a new client starts at the current expire_pos
+        (everything earlier is already in the backing store)."""
+        existing = self.client_pos(cid)
+        if existing is not None:
+            return existing
+        try:
+            self.ioctx.stat(self._clients_oid())
+        except (ObjectNotFound, RadosError):
+            self.ioctx.write_full(self._clients_oid(), b"")
+        self.ioctx.omap_set(
+            self._clients_oid(),
+            {f"client.{cid}": str(self.expire_pos).encode()},
+        )
+        return self.expire_pos
+
+    def update_client(self, cid: str, pos: int) -> None:
+        self.ioctx.omap_set(
+            self._clients_oid(), {f"client.{cid}": str(pos).encode()}
+        )
+
+    def unregister_client(self, cid: str) -> None:
+        try:
+            self.ioctx.omap_rm_keys(
+                self._clients_oid(), [f"client.{cid}"]
+            )
+        except (ObjectNotFound, RadosError):
+            pass
+
+    def client_pos(self, cid: str) -> int | None:
+        try:
+            vals = self.ioctx.omap_get_vals(self._clients_oid())
+        except (ObjectNotFound, RadosError):
+            return None
+        raw = vals.get(f"client.{cid}")
+        return int(raw) if raw is not None else None
+
+    def _clients_min(self) -> int | None:
+        try:
+            vals = self.ioctx.omap_get_vals(self._clients_oid())
+        except (ObjectNotFound, RadosError):
+            return None
+        poss = [
+            int(v) for k, v in vals.items()
+            if k.startswith("client.")
+        ]
+        return min(poss) if poss else None
+
+    def replay_from(self, pos: int):
+        """Yield (entry, end_pos) from ``pos`` to the committed head
+        — the tailing-consumer read (rbd-mirror's journal fetch)."""
+        pos = max(pos, self.expire_pos)
         while pos + _LEN.size <= self.write_pos:
             (n,) = _LEN.unpack(self._read_stream(pos, _LEN.size))
             if pos + _LEN.size + n > self.write_pos:
-                break  # torn tail past the committed head
-            yield self._read_stream(pos + _LEN.size, n)
+                break
+            yield self._read_stream(pos + _LEN.size, n), (
+                pos + _LEN.size + n
+            )
             pos += _LEN.size + n
 
     # -- trim --------------------------------------------------------------
     def trim(self, upto: int | None = None) -> None:
         """Advance expire_pos (everything before it is reflected in
-        the backing store) and delete fully-expired stream objects."""
+        the backing store) and delete fully-expired stream objects.
+        Never trims past the slowest REGISTERED client (rbd-mirror
+        must see every entry before it is deleted)."""
         upto = self.write_pos if upto is None else upto
+        cmin = self._clients_min()
+        if cmin is not None:
+            upto = min(upto, cmin)
         old_obj = self.expire_pos // self.object_size
-        self.expire_pos = min(upto, self.write_pos)
+        # NEVER regress: a client registered from a stale instance
+        # may record a position below the already-trimmed prefix
+        self.expire_pos = max(
+            self.expire_pos, min(upto, self.write_pos)
+        )
         self._write_head()
         for objno in range(old_obj, self.expire_pos // self.object_size):
             try:

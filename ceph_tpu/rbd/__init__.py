@@ -74,9 +74,9 @@ class RBD:
         if size < 0:
             raise RBDError("negative image size")
         feats = {f for f in features.split(",") if f}
-        if not feats <= {"exclusive-lock", "object-map"}:
+        if not feats <= {"exclusive-lock", "object-map", "journaling"}:
             raise RBDError(f"unknown features {features!r} (-EINVAL)")
-        if "object-map" in feats:
+        if "object-map" in feats or "journaling" in feats:
             feats.add("exclusive-lock")
         layout = StripeLayout(stripe_unit, stripe_count, object_size)
         existing = ioctx.omap_get_vals(DIRECTORY) if self._dir_exists(
@@ -260,6 +260,24 @@ class Image:
                 ioctx, f"rbd_object_map.{name}", self._max_objects()
             )
             self._objmap.load()
+        # image journal (librbd/Journal.cc role): mutations append
+        # to a per-image rados journal stream BEFORE the data ships;
+        # the journal tail replays on lock acquisition (crash
+        # consistency) and feeds rbd-mirror (see rbd/mirror.py)
+        self._journal = None
+        self._journal_uncommitted = 0
+        # append+flush must be atomic across concurrent writers, and
+        # replay suppression is THREAD-scoped: a replaying thread's
+        # re-entrant writes skip journaling while other writers'
+        # mutations journal normally
+        self._journal_mu = threading.Lock()
+        self._replay_tls = threading.local()
+        if "journaling" in self.features:
+            from ..mds.journaler import Journaler
+
+            self._journal = Journaler(
+                ioctx, prefix=f"rbd_journal.{name}"
+            )
         if cache:
             if self.parent is not None:
                 # the cacher cannot see parent read-through/copy-up;
@@ -288,7 +306,13 @@ class Image:
                 # the map is only trusted under the lock: reload
                 # what the previous owner persisted
                 self._objmap.load()
+            # _ready flips BEFORE journal replay: replay re-applies
+            # entries through write()/discard(), which re-enter the
+            # owner-ready fast path — entering the mutex again would
+            # self-deadlock
             self._ready = True
+            if self._journal is not None:
+                self._journal_replay_tail()
 
     def _enter_write(self) -> None:
         """Every mutation passes here: wait out a handoff/barrier in
@@ -356,6 +380,73 @@ class Image:
                 self._releasing = False
                 self._wr_cond.notify_all()
 
+    # -- image journal (librbd/Journal.cc reduced) -------------------------
+    def _journal_append(self, op: int, off: int, length: int,
+                        data: bytes = b"") -> None:
+        """Journal-ahead: the entry is DURABLE before the data ships
+        (a crash replays it on the next lock acquisition; rbd-mirror
+        tails the same stream)."""
+        if self._journal is None or getattr(
+            self._replay_tls, "on", False
+        ):
+            return
+        from ..common.encoding import Encoder
+
+        e = Encoder()
+        e.u8(op).u64(off).u64(length).bytes(data)
+        with self._journal_mu:
+            self._journal.append(e.getvalue())
+            self._journal.flush()
+
+    def _journal_commit(self) -> None:
+        """Mark the applied prefix committed (trim honors mirror
+        clients, so entries survive until every consumer saw them)."""
+        if self._journal is None or getattr(
+            self._replay_tls, "on", False
+        ):
+            # replay commits once, at its end — a mid-replay trim
+            # would delete stream objects the generator still reads
+            return
+        self._journal_uncommitted += 1
+        if self._journal_uncommitted >= 16:
+            self._journal_uncommitted = 0
+            with self._journal_mu:
+                self._journal.trim()
+
+    def _journal_replay_tail(self) -> None:
+        """Re-apply the uncommitted journal tail (entries appended
+        by a previous owner that crashed between journal and data;
+        every entry is idempotent absolute-offset state)."""
+        with self._journal_mu:
+            self._journal.load()
+        self._replay_tls.on = True
+        try:
+            for blob in self._journal.replay():
+                self._journal_apply(blob)
+        finally:
+            self._replay_tls.on = False
+        with self._journal_mu:
+            self._journal.trim()
+
+    def _journal_apply(self, blob: bytes) -> None:
+        from ..common.encoding import Decoder
+
+        d = Decoder(blob)
+        op, off, length = d.u8(), d.u64(), d.u64()
+        data = d.bytes()
+        if op == 1:
+            # the entry was in-bounds at append time; the image may
+            # have SHRUNK since (a later resize entry restores it) —
+            # grow transiently rather than wedging replay on the
+            # size check
+            if off + len(data) > self._size:
+                self.resize(off + len(data))
+            self.write(off, data)
+        elif op == 2:
+            self.discard(off, length)
+        elif op == 3:
+            self.resize(off)
+
     def lock_acquire(self) -> None:
         """Explicitly take the exclusive lock (rbd lock acquire)."""
         if self._xlock is None:
@@ -418,8 +509,21 @@ class Image:
         if new_size < 0:
             raise RBDError("negative image size")
         old = self._size
-        if new_size < old:
-            self.discard(new_size, old - new_size)
+        if self._journal is not None and not getattr(
+            self._replay_tls, "on", False
+        ):
+            self._enter_write()
+            try:
+                self._journal_append(3, new_size, 0)
+            finally:
+                self._exit_write()
+        was = getattr(self._replay_tls, "on", False)
+        self._replay_tls.on = True  # the shrink's discard is covered
+        try:                        # by the resize entry (this thread
+            if new_size < old:      # only); don't double-journal
+                self.discard(new_size, old - new_size)
+        finally:
+            self._replay_tls.on = was
         self._size = new_size
         self.ioctx.omap_set(
             _header_oid(self.name), {"size": str(new_size).encode()}
@@ -535,6 +639,7 @@ class Image:
 
         self._enter_write()
         try:
+            self._journal_append(1, offset, len(data), data)
             if self._objmap is not None:
                 # EXISTS lands in the map BEFORE the data ships: a
                 # crash between the two leaves the map conservative
@@ -542,6 +647,7 @@ class Image:
                     [c[0] for c in cuts]
                 )
             list(self._pool.map(write_one, cuts))
+            self._journal_commit()
         finally:
             self._exit_write()
         return len(data)
@@ -556,7 +662,9 @@ class Image:
             return
         self._enter_write()
         try:
+            self._journal_append(2, offset, length)
             self._discard_inner(offset, length)
+            self._journal_commit()
         finally:
             self._exit_write()
 

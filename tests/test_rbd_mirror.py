@@ -1,0 +1,156 @@
+"""rbd journaling + rbd-mirror (src/librbd/Journal.cc,
+src/tools/rbd_mirror/Mirror.cc; the last named rbd feature-plane gap).
+
+The proofs: journaled images replicate CROSS-CLUSTER by journal
+replay (bootstrap full-sync + tail replay of writes/discards/
+resizes); a restarted mirror daemon resumes from its durable client
+position; the journal-ahead tail replays on lock acquisition after
+a crash; trim never deletes entries the mirror has not consumed."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.mds.journaler import Journaler
+from ceph_tpu.rados import Rados
+from ceph_tpu.rbd import RBD, Image
+from ceph_tpu.rbd.mirror import CLIENT_ID, MirrorDaemon
+
+from test_osd_daemon import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def sites():
+    """TWO independent clusters — the rbd-mirror deployment shape."""
+    a, b = MiniCluster(), MiniCluster()
+    try:
+        for c in (a, b):
+            for i in range(3):
+                c.start_osd(i)
+            c.wait_active()
+        ra = Rados("site-a").connect(*a.mon_addr)
+        rb = Rados("site-b").connect(*b.mon_addr)
+        ra.pool_create("mir", pg_num=2)
+        rb.pool_create("mir", pg_num=2)
+        yield ra.open_ioctx("mir"), rb.open_ioctx("mir"), ra, rb
+    finally:
+        for x in ("ra", "rb"):
+            try:
+                locals()[x].shutdown()
+            except Exception:
+                pass
+        a.shutdown()
+        b.shutdown()
+
+
+def _wait(fn, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if fn():
+                return
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def test_cross_cluster_mirroring(sites):
+    src_io, dst_io, _ra, _rb = sites
+    RBD().create(src_io, "vm", 8 << 20, object_size=1 << 20,
+                 stripe_unit=1 << 20, features="journaling")
+    img = Image(src_io, "vm")
+    try:
+        img.write(0, b"A" * 8192)           # pre-daemon history
+        img.write(2 << 20, b"B" * 4096)
+
+        daemon = MirrorDaemon(src_io, dst_io, interval=0.2)
+        try:
+            # bootstrap + tail replay converge the target
+            _wait(
+                lambda: Image(dst_io, "vm").read(0, 8192)
+                == b"A" * 8192,
+                msg="bootstrap sync",
+            )
+            # live mutations stream across
+            img.write(1 << 20, b"C" * 1000)
+            img.discard(2 << 20, 1 << 20)   # whole-object drop
+            _wait(
+                lambda: (
+                    Image(dst_io, "vm").read(1 << 20, 1000)
+                    == b"C" * 1000
+                    and Image(dst_io, "vm").read(2 << 20, 4096)
+                    == b"\0" * 4096
+                ),
+                msg="live replay",
+            )
+            # resize replicates
+            img.resize(12 << 20)
+            img.write(10 << 20, b"D" * 128)
+            _wait(
+                lambda: (
+                    Image(dst_io, "vm").size() == 12 << 20
+                    and Image(dst_io, "vm").read(10 << 20, 128)
+                    == b"D" * 128
+                ),
+                msg="resize replay",
+            )
+        finally:
+            daemon.stop()
+
+        # daemon down: writes queue in the journal (trim must hold
+        # them for the registered client), then a FRESH daemon
+        # resumes from the durable position
+        for i in range(20):
+            img.write(i * 4096, bytes([i]) * 4096)
+        j = Journaler(src_io, prefix="rbd_journal.vm").load()
+        assert j.client_pos(CLIENT_ID) is not None
+        assert j.write_pos > j.client_pos(CLIENT_ID), (
+            "entries should be pending for the mirror"
+        )
+        daemon2 = MirrorDaemon(src_io, dst_io, interval=0.2)
+        try:
+            _wait(
+                lambda: all(
+                    Image(dst_io, "vm").read(i * 4096, 4096)
+                    == bytes([i]) * 4096
+                    for i in (0, 7, 19)
+                ),
+                msg="resume after restart",
+            )
+            assert daemon2.images_synced == 0, (
+                "restart must RESUME, not re-bootstrap"
+            )
+        finally:
+            daemon2.stop()
+    finally:
+        img.close()
+
+
+def test_journal_replays_on_crash(sites):
+    src_io, _dst, _ra, _rb = sites
+    RBD().create(src_io, "crash", 4 << 20, object_size=1 << 20,
+                 stripe_unit=1 << 20, features="journaling")
+    img = Image(src_io, "crash")
+    img.write(0, b"before")
+    # simulate the crash window: the entry is journaled but the data
+    # never ships (append directly, bypassing the image)
+    from ceph_tpu.common.encoding import Encoder
+
+    e = Encoder()
+    e.u8(1).u64(4096).u64(9).bytes(b"recovered")
+    j = Journaler(src_io, prefix="rbd_journal.crash").load()
+    j.append(e.getvalue())
+    j.flush()
+    img.close()  # the "crashed" writer goes away
+
+    # the next owner's lock acquisition replays the tail
+    img2 = Image(src_io, "crash")
+    try:
+        img2.write(8192, b"x")  # forces lock acquisition + replay
+        assert img2.read(4096, 9) == b"recovered"
+        assert img2.read(0, 6) == b"before"
+    finally:
+        img2.close()
