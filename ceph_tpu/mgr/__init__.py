@@ -93,6 +93,8 @@ class Manager(Dispatcher):
                 PrometheusModule,
                 StatusModule,
                 PgAutoscalerModule,
+                TelemetryModule,
+                DashboardModule,
             ]
         )
         self.modules: dict[str, MgrModule] = {}
@@ -427,6 +429,176 @@ class PrometheusModule(MgrModule):
                 labels={"pool": entry["name"]},
             )
         return "\n".join(out) + "\n"
+
+
+class TelemetryModule(MgrModule):
+    """Cluster telemetry report (src/pybind/mgr/telemetry reduced):
+    the same anonymized "basic channel" shape — cluster geometry,
+    pool shapes, daemon versions/perf rollups — generated on tick
+    and kept as the last report.  Deviation: nothing phones home;
+    the report is served locally (module.report() / the dashboard)."""
+
+    NAME = "telemetry"
+    TICK_EVERY = 5.0
+
+    def __init__(self, mgr: "Manager"):
+        super().__init__(mgr)
+        self.last_report: dict = {}
+        self.reports_generated = 0
+
+    def report(self) -> dict:
+        from ..version import FRAMEWORK_VERSION
+
+        stats = self.get("osd_stats") or {}
+        pg = self.get("pg_summary") or {}
+        df = self.get("df") or {"pools": []}
+        perf = self.get("daemon_perf") or {}
+        rep = {
+            "report_version": 1,
+            "version": FRAMEWORK_VERSION,
+            "created": time.time(),
+            "cluster": stats,
+            "pg": pg,
+            "pools": [
+                # anonymized shape, not names (telemetry's
+                # basic-channel redaction)
+                {"id": p["id"], "type": p["type"],
+                 "size": p["size"], "pg_num": p["pg_num"]}
+                for p in df["pools"]
+            ],
+            "daemons": {
+                "count": len(perf),
+                "kinds": sorted(
+                    {d.split(".")[0] for d in perf}
+                ),
+                "total_client_ops": sum(
+                    (dump.get("op") or {}).get("value", 0)
+                    if isinstance(dump.get("op"), dict)
+                    else dump.get("op", 0)
+                    for dump in perf.values()
+                ),
+            },
+        }
+        return rep
+
+    def serve(self) -> None:
+        self.last_report = self.report()
+        self.reports_generated += 1
+
+
+class DashboardModule(MgrModule):
+    """Minimal dashboard (src/pybind/mgr/dashboard reduced to the
+    read-only status surface): an HTTP endpoint serving a live HTML
+    cluster overview plus JSON APIs (/api/health, /api/osds,
+    /api/pools, /api/daemons, /api/telemetry)."""
+
+    NAME = "dashboard"
+
+    def __init__(self, mgr: "Manager"):
+        super().__init__(mgr)
+        module = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _reply(self, body: bytes, ctype: str):
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                try:
+                    if self.path in ("/", "/index.html"):
+                        self._reply(
+                            module.render_html().encode(),
+                            "text/html",
+                        )
+                    elif self.path.startswith("/api/"):
+                        payload = module.api(self.path[5:])
+                        self._reply(
+                            json.dumps(payload).encode(),
+                            "application/json",
+                        )
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+                except Exception:  # noqa: BLE001 — a half-up mgr
+                    # must answer 500, not kill the handler thread
+                    self.send_response(500)
+                    self.end_headers()
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self.server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", int(self.get_module_option("port", 0))),
+            Handler,
+        )
+        self.port = self.server.server_address[1]
+        threading.Thread(
+            target=self.server.serve_forever,
+            name="mgr.dashboard",
+            daemon=True,
+        ).start()
+
+    def shutdown(self) -> None:
+        self.server.shutdown()
+
+    def api(self, what: str):
+        if what == "health":
+            mod = self.mgr.modules.get("status")
+            if isinstance(mod, StatusModule):
+                return mod.health()
+            return self.get("osd_stats")
+        if what == "osds":
+            m = self.get("osd_map")
+            return [
+                {
+                    "osd": o,
+                    "up": m.is_up(o),
+                    "in": m.exists(o) and m.osd_weight[o] > 0,
+                    "addr": m.osd_addrs.get(o, ""),
+                }
+                for o in range(m.max_osd)
+            ] if m is not None else []
+        if what == "pools":
+            return (self.get("df") or {}).get("pools", [])
+        if what == "daemons":
+            return self.get("daemon_perf") or {}
+        if what == "telemetry":
+            mod = self.mgr.modules.get("telemetry")
+            if isinstance(mod, TelemetryModule):
+                return mod.report()
+            return {}
+        raise KeyError(what)
+
+    def render_html(self) -> str:
+        health = self.api("health") or {}
+        osds = self.api("osds")
+        pools = self.api("pools")
+        rows = "".join(
+            f"<tr><td>osd.{o['osd']}</td>"
+            f"<td>{'up' if o['up'] else 'down'}</td>"
+            f"<td>{'in' if o['in'] else 'out'}</td>"
+            f"<td>{o['addr']}</td></tr>"
+            for o in osds
+        )
+        prows = "".join(
+            f"<tr><td>{p['name']}</td><td>{p['pg_num']}</td>"
+            f"<td>{'ec' if p['type'] == 3 else 'rep'}</td>"
+            f"<td>{p['size']}</td></tr>"
+            for p in pools
+        )
+        return (
+            "<html><head><title>ceph-tpu</title></head><body>"
+            f"<h1>cluster: {health.get('status', '?')}</h1>"
+            f"<p>{', '.join(health.get('checks', [])) or 'no checks'}"
+            "</p><h2>osds</h2><table border=1><tr><th>osd</th>"
+            f"<th>state</th><th>in/out</th><th>addr</th></tr>{rows}"
+            "</table><h2>pools</h2><table border=1><tr><th>name</th>"
+            f"<th>pg_num</th><th>type</th><th>size</th></tr>{prows}"
+            "</table></body></html>"
+        )
 
 
 class PgAutoscalerModule(MgrModule):

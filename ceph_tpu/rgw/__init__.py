@@ -79,6 +79,12 @@ SKEW = 900.0  # max x-amz-date clock skew (seconds)
 # tools, tests of the storage logic itself) pass SYSTEM — the
 # reference's system-user bypass in verify_permission
 SYSTEM = "__rgw_system__"
+# the multisite sync agent's identity: same bypass as SYSTEM, but its
+# mutations are NOT datalogged (a mirrored write must not ping-pong
+# between active-active zones; the reference short-circuits on the
+# entry's source zone)
+SYNC_USER = "__rgw_sync__"
+DATALOG_OID = "rgw.datalog"
 _DENIED = object()  # HTTP sentinel: signature rejected, 403 sent
 
 
@@ -192,6 +198,57 @@ class RGW:
         self.auth = auth
         self.lc_worker = None
         self.lc_debug = False
+        self._datalog_lock = threading.Lock()
+        self._datalog_seq: int | None = None
+
+    # -- datalog (rgw datalog/mdlog role, feeding multisite.py) ------------
+    def _log_change(self, op: str, bucket: str, key: str | None,
+                    user) -> None:
+        if user == SYNC_USER:
+            return
+        with self._datalog_lock:
+            if self._datalog_seq is None:
+                last = 0
+                for seq, _e in self.datalog_entries(0):
+                    last = seq
+                self._datalog_seq = last
+            self._datalog_seq += 1
+            seq = self._datalog_seq
+            # OMAPSET touches the object into existence; no stat dance
+            self.io.omap_set(DATALOG_OID, {
+                f"e{seq:016d}": json.dumps(
+                    {"op": op, "bucket": bucket, "key": key}
+                ).encode()
+            })
+
+    def datalog_head(self) -> int:
+        with self._datalog_lock:
+            if self._datalog_seq is not None:
+                return self._datalog_seq
+        # cold start: walk forward from the beginning once
+        last = 0
+        for seq, _e in self.datalog_entries(0):
+            last = seq
+        return last
+
+    def datalog_entries(self, after: int = 0):
+        """(seq, entry) in order for every event past ``after`` —
+        PAGED from the marker (each poll costs the new entries, not
+        the whole history)."""
+        marker = f"e{after:016d}" if after else ""
+        while True:
+            try:
+                vals = self.io.omap_get_vals(
+                    DATALOG_OID, start_after=marker, max_return=256
+                )
+            except (ObjectNotFound, RadosError):
+                return
+            keys = sorted(k for k in vals if k.startswith("e"))
+            if not keys:
+                return
+            for k in keys:
+                yield int(k[1:]), json.loads(vals[k])
+            marker = keys[-1]
 
     # -- users / auth (rgw_user + rgw_auth_s3 roles) -----------------------
     def create_user(self, name: str) -> tuple[str, str]:
@@ -300,7 +357,7 @@ class RGW:
         bucket_owner: str | None = None,
         what: str = "",
     ) -> None:
-        if user == SYSTEM:
+        if user in (SYSTEM, SYNC_USER):
             return
         if not aclmod.check(acl, user, perm, bucket_owner):
             raise AccessDenied(
@@ -312,7 +369,7 @@ class RGW:
         caller must BE the bucket owner — an owner-less (system)
         bucket is manageable only by SYSTEM callers, and anonymous
         NEVER passes (None == None must not authorize)."""
-        if user == SYSTEM:
+        if user in (SYSTEM, SYNC_USER):
             return
         owner = rec.get("owner")
         if user is None or owner is None or user != owner:
@@ -330,6 +387,7 @@ class RGW:
         )
         rec["acl"] = aclmod.make_acl(rec.get("owner"), canned)
         self._save_bucket_rec(bucket, rec)
+        self._log_change("bucket_acl", bucket, None, user)
 
     def get_bucket_acl(self, bucket: str, user=SYSTEM) -> dict:
         rec = self._bucket_rec(bucket)
@@ -352,6 +410,7 @@ class RGW:
         self.io.omap_set(
             _index_oid(bucket), {key: json.dumps(entry).encode()}
         )
+        self._log_change("acl", bucket, key, user)
 
     def get_object_acl(self, bucket: str, key: str, user=SYSTEM) -> dict:
         rec = self._bucket_rec(bucket)
@@ -392,6 +451,7 @@ class RGW:
                 "acl": aclmod.make_acl(owner, canned),
             },
         )
+        self._log_change("create_bucket", bucket, None, user)
 
     def delete_bucket(self, bucket: str, user=SYSTEM) -> None:
         rec = self._bucket_rec(bucket)
@@ -403,6 +463,7 @@ class RGW:
         self.io.remove(_index_oid(bucket))
         self.io.omap_rm_keys(BUCKETS_DIR, [bucket])
         self.io.omap_rm_keys(LC_OID, [bucket])
+        self._log_change("delete_bucket", bucket, None, user)
 
     def put_object(
         self,
@@ -437,6 +498,7 @@ class RGW:
                 ).encode()
             },
         )
+        self._log_change("put", bucket, key, user)
         return etag
 
     def get_object(self, bucket: str, key: str, user=SYSTEM) -> bytes:
@@ -480,6 +542,7 @@ class RGW:
         self.stat_object(bucket, key)
         self._drop_object_data(bucket, key)
         self.io.omap_rm_keys(_index_oid(bucket), [key])
+        self._log_change("delete", bucket, key, user)
 
     # -- lifecycle (rgw_lc.cc reduced; see lifecycle.py) -------------------
     def put_bucket_lifecycle(
@@ -513,6 +576,7 @@ class RGW:
         self.io.omap_set(
             LC_OID, {bucket: json.dumps(rules).encode()}
         )
+        self._log_change("lifecycle", bucket, None, user)
 
     def get_bucket_lifecycle(self, bucket: str, user=SYSTEM) -> list:
         rec = self._bucket_rec(bucket)
@@ -527,6 +591,7 @@ class RGW:
         rec = self._bucket_rec(bucket)
         self._require_owner(user, rec, bucket)
         self.io.omap_rm_keys(LC_OID, [bucket])
+        self._log_change("lifecycle", bucket, None, user)
 
     def lc_process(self, debug: bool | None = None) -> dict:
         """One scan over every configured bucket (RGWLC::process)."""
@@ -570,6 +635,7 @@ class RGW:
         self.io.omap_set(
             _index_oid(bucket), {key: json.dumps(entry).encode()}
         )
+        self._log_change("transition", bucket, key, None)
         for oid in old_oids:
             if oid == cold_oid:
                 continue
@@ -709,6 +775,7 @@ class RGW:
                 for n, _m in parts
             ],
         )
+        self._log_change("put", bucket, key, user)
         return etag
 
     def abort_multipart(

@@ -1,0 +1,154 @@
+"""RGW multisite sync (src/rgw/rgw_sync.cc + rgw_data_sync.cc; a
+named missing plane in every verdict).
+
+The proofs: a secondary zone bootstraps by full sync and then tails
+the primary's datalog incrementally (puts/deletes/ACLs/lifecycle
+configs); a restarted agent resumes from its destination-persisted
+marker; active-active agents converge without ping-ponging."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.rados import Rados
+from ceph_tpu.rgw import RGW, SYSTEM
+from ceph_tpu.rgw.multisite import SyncAgent
+
+from test_osd_daemon import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def zones():
+    c = MiniCluster()
+    try:
+        for i in range(3):
+            c.start_osd(i)
+        c.wait_active()
+        r = Rados("ms-test").connect(*c.mon_addr)
+        r.pool_create("zonea", pg_num=2)
+        r.pool_create("zoneb", pg_num=2)
+        a = RGW(r.open_ioctx("zonea"))
+        b = RGW(r.open_ioctx("zoneb"))
+        yield a, b
+        a.shutdown()
+        b.shutdown()
+        r.shutdown()
+    finally:
+        c.shutdown()
+
+
+def _wait(fn, timeout=60.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if fn():
+                return
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def test_zone_sync_bootstrap_and_incremental(zones):
+    a, b = zones
+    # pre-agent history at the primary
+    a.create_bucket("photos", user="alice", canned="public-read")
+    a.put_object("photos", "p1.jpg", b"jpeg-one", user="alice")
+    a.put_object("photos", "p2.jpg", b"jpeg-two", user="alice")
+    a.put_bucket_lifecycle(
+        "photos",
+        [{"id": "e", "prefix": "tmp/", "status": "Enabled",
+          "expiration_days": 30}],
+        user="alice",
+    )
+
+    agent = SyncAgent(a, b, zone="zb", interval=0.2)
+    try:
+        # bootstrap: everything (data + acl + lifecycle) appears at b
+        _wait(
+            lambda: b.get_object("photos", "p1.jpg", user=SYSTEM)
+            == b"jpeg-one",
+            msg="bootstrap",
+        )
+        assert b.get_object("photos", "p2.jpg", user=SYSTEM) == b"jpeg-two"
+        assert b._bucket_rec("photos")["owner"] == "alice"
+        # the public-read bucket ACL traveled: anonymous listing works
+        assert b.list_objects("photos", user=None)
+        assert b.get_bucket_lifecycle("photos", user=SYSTEM)[0]["id"] == "e"
+        assert agent.full_syncs == 1
+
+        # incremental: puts, deletes, acl flips stream across
+        a.put_object("photos", "p3.jpg", b"jpeg-three", user="alice")
+        a.delete_object("photos", "p1.jpg", user="alice")
+        a.set_object_acl("photos", "p2.jpg", "public-read",
+                         user="alice")
+        _wait(
+            lambda: (
+                b.get_object("photos", "p3.jpg", user=SYSTEM)
+                == b"jpeg-three"
+            ),
+            msg="incremental put",
+        )
+        _wait(
+            lambda: "p1.jpg" not in {
+                e["key"]
+                for e in b.list_objects("photos", user=SYSTEM)[0]
+            },
+            msg="incremental delete",
+        )
+        # object acl traveled: anonymous read allowed at the replica
+        _wait(
+            lambda: b.get_object("photos", "p2.jpg", user=None)
+            == b"jpeg-two",
+            msg="acl sync",
+        )
+    finally:
+        agent.stop()
+
+    # agent down: primary keeps mutating; a FRESH agent resumes from
+    # the destination-persisted marker (no re-bootstrap)
+    a.put_object("photos", "p4.jpg", b"jpeg-four", user="alice")
+    agent2 = SyncAgent(a, b, zone="zb", interval=0.2)
+    try:
+        _wait(
+            lambda: b.get_object("photos", "p4.jpg", user=SYSTEM)
+            == b"jpeg-four",
+            msg="resume",
+        )
+        assert agent2.full_syncs == 0, "restart must resume, not re-sync"
+    finally:
+        agent2.stop()
+
+
+def test_active_active_converges(zones):
+    a, b = zones
+    a.create_bucket("east", user="east-user")
+    b.create_bucket("west", user="west-user")
+    a.put_object("east", "e1", b"from-east", user="east-user")
+    b.put_object("west", "w1", b"from-west", user="west-user")
+
+    ab = SyncAgent(a, b, zone="zb2", interval=0.2)
+    ba = SyncAgent(b, a, zone="za2", interval=0.2)
+    try:
+        _wait(
+            lambda: b.get_object("east", "e1", user=SYSTEM)
+            == b"from-east",
+            msg="east->west",
+        )
+        _wait(
+            lambda: a.get_object("west", "w1", user=SYSTEM)
+            == b"from-west",
+            msg="west->east",
+        )
+        # convergence is STABLE: mirrored applies are not re-logged,
+        # so the datalogs stop growing once both sides are caught up
+        time.sleep(1.0)
+        ha, hb = a.datalog_head(), b.datalog_head()
+        time.sleep(1.5)
+        assert a.datalog_head() == ha, "zone A datalog ping-pongs"
+        assert b.datalog_head() == hb, "zone B datalog ping-pongs"
+    finally:
+        ab.stop()
+        ba.stop()
