@@ -460,6 +460,13 @@ class Image:
     def is_lock_owner(self) -> bool:
         return self._xlock is not None and self._xlock.is_owner
 
+    def lock_holder(self) -> str | None:
+        """Current exclusive-lock holder cookie, or None (the rbd
+        lock-status surface)."""
+        if self._xlock is None:
+            raise RBDError("exclusive-lock feature not enabled")
+        return self._xlock._holder()
+
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:
         # drain in-flight aio FIRST: a queued aio_write must buffer

@@ -40,14 +40,20 @@ class MirrorDaemon:
         self.images_synced = 0  # observability
         self.entries_replayed = 0
         self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._loop, name="rbd-mirror", daemon=True
-        )
-        self._thread.start()
+        self._thread = None
+        if interval > 0:
+            # interval=0: no background thread — the caller drives
+            # replay_once() itself (the CLI's --once mode; a thread
+            # racing it would replay the same entries concurrently)
+            self._thread = threading.Thread(
+                target=self._loop, name="rbd-mirror", daemon=True
+            )
+            self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
-        self._thread.join(timeout=10)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
 
     # -- discovery ---------------------------------------------------------
     def _journaled_images(self) -> list[str]:

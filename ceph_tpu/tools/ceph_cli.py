@@ -66,6 +66,41 @@ def _build_command(args: list[str]) -> dict:
             "id": int(args[2]),
             "weight": float(args[3]),
         }
+    if joined.startswith("osd blocklist"):
+        # osd blocklist add|rm|ls [ADDR] [EXPIRE]
+        cmd = {"prefix": "osd blocklist", "blocklistop": args[2]}
+        if len(args) > 3:
+            cmd["addr"] = args[3]
+        if len(args) > 4:
+            cmd["expire"] = float(args[4])
+        return cmd
+    if joined.startswith("osd tier"):
+        # osd tier add|remove|cache-mode|set-overlay BASE CACHE
+        # osd tier cache-mode BASE CACHE MODE
+        # osd tier remove-overlay BASE
+        op = args[2]
+        cmd = {"prefix": "osd tier", "tierop": op, "pool": args[3]}
+        if op in ("add", "remove", "cache-mode", "set-overlay"):
+            if len(args) < 5:
+                raise SystemExit(
+                    f"osd tier {op} needs BASE CACHE"
+                )
+            cmd["tierpool"] = args[4]
+        if op == "cache-mode" and len(args) > 5:
+            cmd["mode"] = args[5]
+        return cmd
+    if joined.startswith("mds pin"):
+        return {"prefix": "mds pin", "path": args[2],
+                "rank": int(args[3])}
+    if joined.startswith("mds set-max-mds"):
+        return {"prefix": "mds set-max-mds", "max_mds": int(args[2])}
+    if joined.startswith("mds fail"):
+        return {"prefix": "mds fail", "who": args[2]}
+    if joined.startswith("mds stat"):
+        return {"prefix": "mds stat"}
+    if joined.startswith("osd pool set"):
+        return {"prefix": "osd pool set", "pool": args[3],
+                "var": args[4], "val": args[5]}
     if joined.startswith("osd tree"):
         return {"prefix": "osd tree"}
     if joined.startswith("osd dump"):

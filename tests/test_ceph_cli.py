@@ -94,3 +94,37 @@ def test_cli_against_live_monitor(capsys, mon):
 
     rc, out = _run(capsys, mon, "bogus", "command")
     assert rc != 0
+
+
+def test_round5_command_translations():
+    """argv → JSON command shapes for the round-5 admin surface
+    (blocklist, cache tiers, multi-MDS, pool vars)."""
+    from ceph_tpu.tools.ceph_cli import _build_command as b
+
+    assert b(["osd", "blocklist", "add", "abc123", "60"]) == {
+        "prefix": "osd blocklist", "blocklistop": "add",
+        "addr": "abc123", "expire": 60.0,
+    }
+    assert b(["osd", "blocklist", "ls"]) == {
+        "prefix": "osd blocklist", "blocklistop": "ls",
+    }
+    assert b(["osd", "tier", "add", "base", "cache"]) == {
+        "prefix": "osd tier", "tierop": "add", "pool": "base",
+        "tierpool": "cache",
+    }
+    assert b(
+        ["osd", "tier", "cache-mode", "base", "cache", "writeback"]
+    ) == {
+        "prefix": "osd tier", "tierop": "cache-mode", "pool": "base",
+        "tierpool": "cache", "mode": "writeback",
+    }
+    assert b(["mds", "pin", "/hot", "1"]) == {
+        "prefix": "mds pin", "path": "/hot", "rank": 1,
+    }
+    assert b(["mds", "set-max-mds", "2"]) == {
+        "prefix": "mds set-max-mds", "max_mds": 2,
+    }
+    assert b(["osd", "pool", "set", "p", "pg_num", "8"]) == {
+        "prefix": "osd pool set", "pool": "p", "var": "pg_num",
+        "val": "8",
+    }
