@@ -50,9 +50,15 @@ expiration + storage-class transition rules applied by a scanning
 worker; COLD transition really recompresses the payload through the
 compressor registry; ``?lifecycle`` subresource round-trips configs.
 
-Deviations, documented: keystone/STS, multisite, CORS absent;
-region/service names checked only for self-consistency; single
-pool; lifecycle configs are JSON on the wire (not S3's XML schema).
+STS (round 5): GetSessionToken/AssumeRole mint expiring temporary
+credentials (12h cap, session creds may not re-mint) that sign
+requests exactly like permanent keys.  Multisite (round 5,
+multisite.py): per-zone datalog + cross-zone sync agents.
+
+Deviations, documented: keystone and CORS absent; STS issues no role
+ARNs/policies (the temp identity IS the caller); region/service
+names checked only for self-consistency; single pool; lifecycle
+configs are JSON on the wire (not S3's XML schema).
 """
 
 from __future__ import annotations
@@ -198,6 +204,12 @@ class RGW:
         self.auth = auth
         self.lc_worker = None
         self.lc_debug = False
+        # set by _verify per call: was the last verified identity a
+        # temporary (STS) credential?  Read immediately by the STS
+        # route to refuse self-renewal (handler threads each verify
+        # right before reading it, so the gap is per-thread-benign —
+        # worst case a refused re-mint)
+        self._last_caller_temp = False
         self._datalog_lock = threading.Lock()
         self._datalog_seq: int | None = None
 
@@ -251,25 +263,55 @@ class RGW:
             marker = keys[-1]
 
     # -- users / auth (rgw_user + rgw_auth_s3 roles) -----------------------
+    def _put_user_key(self, access: str, record: dict) -> None:
+        try:
+            self.io.stat(USERS_OID)
+        except (ObjectNotFound, RadosError):
+            self.io.write_full(USERS_OID, b"")
+        self.io.omap_set(
+            USERS_OID, {access: json.dumps(record).encode()}
+        )
+
     def create_user(self, name: str) -> tuple[str, str]:
         """Provision a user; returns (access_key, secret_key)."""
         import os as _os
 
         access = _os.urandom(10).hex().upper()
         secret = _os.urandom(20).hex()
-        try:
-            self.io.stat(USERS_OID)
-        except (ObjectNotFound, RadosError):
-            self.io.write_full(USERS_OID, b"")
-        self.io.omap_set(
-            USERS_OID,
-            {
-                access: json.dumps(
-                    {"name": name, "secret": secret}
-                ).encode()
-            },
+        self._put_user_key(
+            access, {"name": name, "secret": secret}
         )
         return access, secret
+
+    # -- STS (rgw_sts.cc / rgw_rest_sts.cc reduced) ------------------------
+    def assume_role(
+        self, user: str, duration: float = 3600.0
+    ) -> tuple[str, str, float]:
+        """Issue TEMPORARY credentials bound to ``user`` (the
+        AssumeRole/GetSessionToken seat): a fresh access/secret pair
+        that signs requests exactly like permanent keys but expires.
+        Deviations: no role ARNs/policies — the temp identity IS the
+        requesting user (GetSessionToken semantics), and the
+        response is JSON, not STS XML."""
+        import math
+        import os as _os
+
+        duration = float(duration)
+        if not math.isfinite(duration) or not (
+            0 < duration <= 12 * 3600
+        ):
+            # nan/inf would defeat the expiry compare entirely; STS
+            # itself caps sessions at 12h
+            raise RGWError(
+                "DurationSeconds must be in (0, 43200] (-EINVAL)"
+            )
+        access = "TEMP" + _os.urandom(8).hex().upper()
+        secret = _os.urandom(20).hex()
+        expires = time.time() + duration
+        self._put_user_key(access, {
+            "name": user, "secret": secret, "expires": expires,
+        })
+        return access, secret, expires
 
     def _verify(self, method, path, query, headers, payload) -> str:
         """SigV4 verification; returns the user name or raises
@@ -311,6 +353,14 @@ class RGW:
             )
         except (KeyError, ObjectNotFound, RadosError):
             raise AccessDenied("unknown access key")
+        if "expires" in user and time.time() > float(user["expires"]):
+            # expired STS credentials die hard (and get reaped so
+            # the user store does not accrete dead keys)
+            try:
+                self.io.omap_rm_keys(USERS_OID, [access])
+            except (ObjectNotFound, RadosError):
+                pass
+            raise AccessDenied("temporary credentials expired")
         want = sign_request(
             method, path, query, payload, access, user["secret"],
             region=region, amz_date=amz_date,
@@ -319,6 +369,7 @@ class RGW:
 
         if not hmac_mod.compare_digest(want, authz):
             raise AccessDenied("signature mismatch")
+        self._last_caller_temp = "expires" in user
         return user["name"]
 
     # -- ACL plumbing (rgw_acl.cc verify_permission seat) ------------------
@@ -1100,7 +1151,45 @@ class RGW:
                 if user is _DENIED:
                     return
                 try:
-                    if key is not None and "uploads" in q:
+                    if bucket is None and q.get("Action") in (
+                        "AssumeRole", "GetSessionToken"
+                    ):
+                        if user is None:
+                            self._err(
+                                403, "AccessDenied",
+                                "STS needs an authenticated caller",
+                            )
+                            return
+                        if gw._last_caller_temp:
+                            # session credentials may not self-renew
+                            # (real STS rejects this too) — a leaked
+                            # short-lived key must actually die
+                            self._err(
+                                403, "AccessDenied",
+                                "temporary credentials cannot call STS",
+                            )
+                            return
+                        try:
+                            dur = float(
+                                q.get("DurationSeconds", 3600)
+                            )
+                        except ValueError:
+                            self._err(
+                                400, "MalformedRequest",
+                                "bad DurationSeconds",
+                            )
+                            return
+                        acc, sec, exp = gw.assume_role(user, dur)
+                        self._reply(
+                            200,
+                            json.dumps({
+                                "AccessKeyId": acc,
+                                "SecretAccessKey": sec,
+                                "Expiration": exp,
+                            }).encode(),
+                            ctype="application/json",
+                        )
+                    elif key is not None and "uploads" in q:
                         upload_id = gw.initiate_multipart(
                             bucket, key, user=user
                         )

@@ -217,3 +217,102 @@ def test_lifecycle_transition_to_cold(gw):
     assert gw.get_object("coldbuck", "warm.bin", user="alice") == payload
     # a second pass is idempotent
     assert gw.lc_process(debug=True)["transitioned"] == 0
+
+def test_sts_temporary_credentials(gw):
+    """STS-style temporary credentials (rgw_sts.cc reduced): an
+    authenticated caller mints expiring keys over HTTP; they sign
+    requests as that user until expiry, then die hard."""
+    import urllib.parse
+    import urllib.request
+
+    access, secret = gw.create_user("stsuser")
+    port = gw.serve()
+    base = f"http://127.0.0.1:{port}"
+
+    def call(method, path, payload=b"", creds=None, query=None,
+             signed=True):
+        q = dict(query or {})
+        url = base + path
+        if q:
+            url += "?" + urllib.parse.urlencode(q)
+        req = urllib.request.Request(
+            url, data=payload or None, method=method
+        )
+        if signed:
+            a, s = creds or (access, secret)
+            for k, v in sign_request(
+                method, path, q, payload, a, s
+            ).items():
+                req.add_header(k, v)
+        return urllib.request.urlopen(req, timeout=10)
+
+    # anonymous callers cannot mint credentials
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        call("POST", "/", query={"Action": "AssumeRole"},
+             signed=False)
+    assert ei.value.code == 403
+
+    creds = json.loads(call(
+        "POST", "/",
+        query={"Action": "AssumeRole", "DurationSeconds": "2"},
+    ).read())
+    temp = (creds["AccessKeyId"], creds["SecretAccessKey"])
+    assert temp[0].startswith("TEMP")
+
+    # the temp identity IS the requesting user: it creates and owns
+    call("PUT", "/stsbucket", creds=temp)
+    call("PUT", "/stsbucket/obj", payload=b"sts data", creds=temp)
+    got = call("GET", "/stsbucket/obj", creds=temp).read()
+    assert got == b"sts data"
+    assert gw._bucket_rec("stsbucket")["owner"] == "stsuser"
+    # ...and the PERMANENT identity can read its own bucket
+    assert call("GET", "/stsbucket/obj").read() == b"sts data"
+
+    # expiry is enforced
+    time.sleep(2.5)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        call("GET", "/stsbucket/obj", creds=temp)
+    assert ei.value.code == 403
+    # permanent keys keep working
+    assert call("GET", "/stsbucket/obj").read() == b"sts data"
+
+
+def test_sts_hardening(gw):
+    """Session credentials cannot self-renew; durations validate."""
+    import urllib.parse
+    import urllib.request
+
+    access, secret = gw.create_user("sts2")
+    port = gw.serve()
+    base = f"http://127.0.0.1:{port}"
+
+    def call(method, path, creds, query=None):
+        q = dict(query or {})
+        url = base + path + (
+            "?" + urllib.parse.urlencode(q) if q else ""
+        )
+        req = urllib.request.Request(url, method=method)
+        for k, v in sign_request(
+            method, path, q, b"", *creds
+        ).items():
+            req.add_header(k, v)
+        return urllib.request.urlopen(req, timeout=10)
+
+    # malformed / out-of-range durations are 4xx, not socket drops
+    for bad in ("abc", "nan", "inf", "0", "999999999"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            call("POST", "/", (access, secret), query={
+                "Action": "AssumeRole", "DurationSeconds": bad,
+            })
+        assert ei.value.code in (400, 409), (bad, ei.value.code)
+
+    creds = json.loads(call("POST", "/", (access, secret), query={
+        "Action": "AssumeRole", "DurationSeconds": "60",
+    }).read())
+    temp = (creds["AccessKeyId"], creds["SecretAccessKey"])
+    # a temp credential may NOT mint more credentials
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        call("POST", "/", temp, query={
+            "Action": "AssumeRole", "DurationSeconds": "60",
+        })
+    assert ei.value.code == 403
