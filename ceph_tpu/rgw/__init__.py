@@ -55,7 +55,10 @@ credentials (12h cap, session creds may not re-mint) that sign
 requests exactly like permanent keys.  Multisite (round 5,
 multisite.py): per-zone datalog + cross-zone sync agents.
 
-Deviations, documented: keystone and CORS absent; STS issues no role
+CORS (round 5): per-bucket rules (?cors subresource), OPTIONS
+preflight, and Allow-Origin echo on admitted requests.
+
+Deviations, documented: keystone absent; STS issues no role
 ARNs/policies (the temp identity IS the caller); region/service
 names checked only for self-consistency; single pool; lifecycle
 configs are JSON on the wire (not S3's XML schema).
@@ -473,6 +476,76 @@ class RGW:
         return entry.get("acl") or aclmod.make_acl(
             entry.get("owner")
         )
+
+    # -- CORS (rgw_cors.cc reduced) ----------------------------------------
+    def put_bucket_cors(
+        self, bucket: str, rules: list[dict], user=SYSTEM
+    ) -> None:
+        """Owner-gated CORS configuration: rules of
+        {allowed_origins, allowed_methods, allowed_headers?,
+        max_age?}; '*' wildcards origins."""
+        rec = self._bucket_rec(bucket)
+        self._require_owner(user, rec, bucket)
+        known = {"GET", "PUT", "POST", "DELETE", "HEAD"}
+
+        def _ok(r):
+            return (
+                isinstance(r, dict)
+                and isinstance(r.get("allowed_origins"), list)
+                and r["allowed_origins"]
+                and all(
+                    isinstance(o, str) for o in r["allowed_origins"]
+                )
+                and isinstance(r.get("allowed_methods"), list)
+                and r["allowed_methods"]
+                and set(r["allowed_methods"]) <= known
+            )
+
+        if not isinstance(rules, list) or not all(
+            _ok(r) for r in rules
+        ):
+            # STRING values would pass a truthiness check and then
+            # char/substring-match in cors_match ("GET" in "FORGET",
+            # '*' in "*.example") — lists of strings only
+            raise RGWError(
+                "each CORS rule needs allowed_origins (list of "
+                "strings) and allowed_methods (list from "
+                "GET/PUT/POST/DELETE/HEAD)"
+            )
+        rec["cors"] = rules
+        self._save_bucket_rec(bucket, rec)
+        self._log_change("bucket_acl", bucket, None, user)
+
+    def get_bucket_cors(self, bucket: str, user=SYSTEM) -> list:
+        rec = self._bucket_rec(bucket)
+        self._require_owner(user, rec, bucket)
+        return rec.get("cors", [])
+
+    def delete_bucket_cors(self, bucket: str, user=SYSTEM) -> None:
+        rec = self._bucket_rec(bucket)
+        self._require_owner(user, rec, bucket)
+        rec.pop("cors", None)
+        self._save_bucket_rec(bucket, rec)
+        self._log_change("bucket_acl", bucket, None, user)
+
+    def cors_match(
+        self, bucket: str, origin: str, method: str
+    ) -> dict | None:
+        """First rule admitting (origin, method), else None — the
+        RGWCORSConfiguration::host_name_rule walk."""
+        try:
+            rules = self._bucket_rec(bucket).get("cors", [])
+        except RGWError:
+            return None
+        for rule in rules:
+            origins = rule.get("allowed_origins", [])
+            if not any(
+                o == "*" or o == origin for o in origins
+            ):
+                continue
+            if method in rule.get("allowed_methods", []):
+                return rule
+        return None
 
     # -- storage logic (rgw_rados roles) -----------------------------------
     def _buckets(self) -> dict[str, bytes]:
@@ -909,6 +982,18 @@ class RGW:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                headers = dict(headers or {})
+                # CORS echo on EVERY response (success AND error —
+                # a browser cannot read an un-echoed 403) for the
+                # actual request's method; explicit headers win
+                if (
+                    "Access-Control-Allow-Origin" not in headers
+                    and self.headers.get("Origin")
+                    and self.command != "OPTIONS"
+                ):
+                    headers.update(self._cors_headers(
+                        self._route()[0], self.command
+                    ))
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
                 self.end_headers()
@@ -973,13 +1058,62 @@ class RGW:
                     self._err(403, "AccessDenied", str(e))
                     return _DENIED
 
+            def do_OPTIONS(self):  # noqa: N802
+                """CORS preflight (RGWHandler preflight dispatch)."""
+                bucket, _key, _q = self._route()
+                origin = self.headers.get("Origin", "")
+                want = self.headers.get(
+                    "Access-Control-Request-Method", ""
+                )
+                rule = (
+                    gw.cors_match(bucket, origin, want)
+                    if bucket and origin and want
+                    else None
+                )
+                if rule is None:
+                    self._reply(403)
+                    return
+                self._reply(200, b"", headers={
+                    "Access-Control-Allow-Origin": origin,
+                    "Access-Control-Allow-Methods": ", ".join(
+                        rule.get("allowed_methods", [])
+                    ),
+                    "Access-Control-Allow-Headers": ", ".join(
+                        rule.get("allowed_headers", ["*"])
+                    ),
+                    "Access-Control-Max-Age": str(
+                        rule.get("max_age", 600)
+                    ),
+                })
+
+            def _cors_headers(self, bucket, method) -> dict:
+                """Actual-request CORS echo: attach Allow-Origin when
+                a rule admits this (Origin, method)."""
+                origin = self.headers.get("Origin", "")
+                if not bucket or not origin:
+                    return {}
+                rule = gw.cors_match(bucket, origin, method)
+                if rule is None:
+                    return {}
+                return {"Access-Control-Allow-Origin": origin}
+
             def do_GET(self):  # noqa: N802
                 bucket, key, q = self._route()
                 user = self._user("GET", b"")
                 if user is _DENIED:
                     return
                 try:
-                    if bucket is not None and "acl" in q:
+                    if bucket is not None and key is None and (
+                        "cors" in q
+                    ):
+                        self._reply(
+                            200,
+                            json.dumps(
+                                gw.get_bucket_cors(bucket, user=user)
+                            ).encode(),
+                            ctype="application/json",
+                        )
+                    elif bucket is not None and "acl" in q:
                         policy = (
                             gw.get_bucket_acl(bucket, user=user)
                             if key is None
@@ -1104,6 +1238,13 @@ class RGW:
                             gw.set_object_acl(
                                 bucket, key, canned, user=user
                             )
+                        self._reply(200)
+                    elif bucket is not None and key is None and (
+                        "cors" in q
+                    ):
+                        gw.put_bucket_cors(
+                            bucket, json.loads(body), user=user
+                        )
                         self._reply(200)
                     elif bucket is not None and key is None and (
                         "lifecycle" in q
@@ -1232,6 +1373,8 @@ class RGW:
                         gw.abort_multipart(
                             bucket, key, q["uploadId"], user=user
                         )
+                    elif key is None and "cors" in q:
+                        gw.delete_bucket_cors(bucket, user=user)
                     elif key is None and "lifecycle" in q:
                         gw.delete_bucket_lifecycle(bucket, user=user)
                     elif key is None:

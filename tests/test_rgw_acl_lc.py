@@ -22,6 +22,30 @@ from ceph_tpu.rgw import RGW, AccessDenied, RGWError, sign_request
 from test_osd_daemon import MiniCluster
 
 
+def _http_call(port, access, secret, method, path, payload=b"",
+               headers=None, query=None, signed=True):
+    """One signed (or anonymous) HTTP request against a gateway —
+    the shared shape four tests were each re-defining."""
+    import urllib.parse
+    import urllib.request
+
+    q = dict(query or {})
+    url = f"http://127.0.0.1:{port}{path}" + (
+        "?" + urllib.parse.urlencode(q) if q else ""
+    )
+    req = urllib.request.Request(
+        url, data=payload or None, method=method
+    )
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    if signed:
+        for k, v in sign_request(
+            method, path, q, payload, access, secret
+        ).items():
+            req.add_header(k, v)
+    return urllib.request.urlopen(req, timeout=10)
+
+
 @pytest.fixture(scope="module")
 def cluster():
     c = MiniCluster()
@@ -279,24 +303,13 @@ def test_sts_temporary_credentials(gw):
 
 def test_sts_hardening(gw):
     """Session credentials cannot self-renew; durations validate."""
-    import urllib.parse
-    import urllib.request
-
     access, secret = gw.create_user("sts2")
     port = gw.serve()
-    base = f"http://127.0.0.1:{port}"
 
     def call(method, path, creds, query=None):
-        q = dict(query or {})
-        url = base + path + (
-            "?" + urllib.parse.urlencode(q) if q else ""
+        return _http_call(
+            port, creds[0], creds[1], method, path, query=query
         )
-        req = urllib.request.Request(url, method=method)
-        for k, v in sign_request(
-            method, path, q, b"", *creds
-        ).items():
-            req.add_header(k, v)
-        return urllib.request.urlopen(req, timeout=10)
 
     # malformed / out-of-range durations are 4xx, not socket drops
     for bad in ("abc", "nan", "inf", "0", "999999999"):
@@ -316,3 +329,74 @@ def test_sts_hardening(gw):
             "Action": "AssumeRole", "DurationSeconds": "60",
         })
     assert ei.value.code == 403
+
+
+def test_cors_preflight_and_echo(gw):
+    """Per-bucket CORS (rgw_cors.cc reduced): config round-trip,
+    OPTIONS preflight allow/deny, Allow-Origin echo on admitted
+    actual requests."""
+    access, secret = gw.create_user("corsuser")
+    port = gw.serve()
+
+    def call(method, path, payload=b"", headers=None, query=None,
+             signed=True):
+        return _http_call(
+            port, access, secret, method, path, payload=payload,
+            headers=headers, query=query, signed=signed,
+        )
+
+    call("PUT", "/corsb")
+    call("PUT", "/corsb/pub", payload=b"cors data",
+         headers={"x-amz-acl": "public-read"})
+    rules = [{
+        "allowed_origins": ["https://app.example"],
+        "allowed_methods": ["GET"],
+        "allowed_headers": ["content-type"],
+        "max_age": 300,
+    }]
+    call("PUT", "/corsb", query={"cors": ""},
+         payload=json.dumps(rules).encode())
+    got = json.loads(
+        call("GET", "/corsb", query={"cors": ""}).read()
+    )
+    assert got == rules
+
+    # preflight: admitted origin+method passes with the rule's headers
+    ok = call("OPTIONS", "/corsb/pub", signed=False, headers={
+        "Origin": "https://app.example",
+        "Access-Control-Request-Method": "GET",
+    })
+    assert ok.status == 200
+    assert ok.headers["Access-Control-Allow-Origin"] == (
+        "https://app.example"
+    )
+    assert "GET" in ok.headers["Access-Control-Allow-Methods"]
+    # wrong origin or method: refused
+    for hdrs in (
+        {"Origin": "https://evil.example",
+         "Access-Control-Request-Method": "GET"},
+        {"Origin": "https://app.example",
+         "Access-Control-Request-Method": "DELETE"},
+    ):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            call("OPTIONS", "/corsb/pub", signed=False, headers=hdrs)
+        assert ei.value.code == 403
+
+    # actual request: admitted Origin gets the Allow-Origin echo
+    resp = call("GET", "/corsb/pub", signed=False,
+                headers={"Origin": "https://app.example"})
+    assert resp.read() == b"cors data"
+    assert resp.headers["Access-Control-Allow-Origin"] == (
+        "https://app.example"
+    )
+    # un-admitted Origin: object still serves (public-read), no echo
+    resp = call("GET", "/corsb/pub", signed=False,
+                headers={"Origin": "https://evil.example"})
+    assert resp.read() == b"cors data"
+    assert resp.headers.get("Access-Control-Allow-Origin") is None
+
+    # config removal
+    call("DELETE", "/corsb", query={"cors": ""})
+    assert json.loads(
+        call("GET", "/corsb", query={"cors": ""}).read()
+    ) == []
