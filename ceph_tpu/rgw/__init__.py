@@ -213,6 +213,8 @@ class RGW:
         # right before reading it, so the gap is per-thread-benign —
         # worst case a refused re-mint)
         self._last_caller_temp = False
+        # bucket -> (stamp, rules); see cors_match
+        self._cors_cache: dict[str, tuple] = {}
         self._datalog_lock = threading.Lock()
         self._datalog_seq: int | None = None
 
@@ -489,6 +491,12 @@ class RGW:
         known = {"GET", "PUT", "POST", "DELETE", "HEAD"}
 
         def _ok(r):
+            import math
+
+            hdrs = r.get("allowed_headers", ["*"]) if isinstance(
+                r, dict
+            ) else None
+            age = r.get("max_age", 600) if isinstance(r, dict) else 0
             return (
                 isinstance(r, dict)
                 and isinstance(r.get("allowed_origins"), list)
@@ -499,6 +507,14 @@ class RGW:
                 and isinstance(r.get("allowed_methods"), list)
                 and r["allowed_methods"]
                 and set(r["allowed_methods"]) <= known
+                # headers/max_age reach ', '.join and str() in the
+                # preflight reply: same list-of-strings rule
+                and isinstance(hdrs, list)
+                and all(isinstance(h, str) for h in hdrs)
+                and isinstance(age, (int, float))
+                and not isinstance(age, bool)
+                and math.isfinite(age)
+                and age >= 0
             )
 
         if not isinstance(rules, list) or not all(
@@ -514,6 +530,7 @@ class RGW:
             )
         rec["cors"] = rules
         self._save_bucket_rec(bucket, rec)
+        self._cors_cache.pop(bucket, None)
         self._log_change("bucket_acl", bucket, None, user)
 
     def get_bucket_cors(self, bucket: str, user=SYSTEM) -> list:
@@ -526,16 +543,33 @@ class RGW:
         self._require_owner(user, rec, bucket)
         rec.pop("cors", None)
         self._save_bucket_rec(bucket, rec)
+        self._cors_cache.pop(bucket, None)
         self._log_change("bucket_acl", bucket, None, user)
 
     def cors_match(
         self, bucket: str, origin: str, method: str
     ) -> dict | None:
         """First rule admitting (origin, method), else None — the
-        RGWCORSConfiguration::host_name_rule walk."""
-        try:
-            rules = self._bucket_rec(bucket).get("cors", [])
-        except RGWError:
+        RGWCORSConfiguration::host_name_rule walk.  Rules read
+        through a short-TTL cache: the echo in _reply would
+        otherwise double the bucket-record reads on every
+        Origin-carrying request (config changes propagate within
+        the TTL; browsers cache preflights far longer via max_age)."""
+        now = time.monotonic()
+        hit = self._cors_cache.get(bucket)
+        if hit is not None and now - hit[0] < 5.0:
+            rules = hit[1]
+        else:
+            try:
+                rules = self._bucket_rec(bucket).get("cors", [])
+            except RGWError:
+                rules = []
+            self._cors_cache[bucket] = (now, rules)
+            if len(self._cors_cache) > 1024:
+                self._cors_cache.pop(
+                    next(iter(self._cors_cache))
+                )
+        if not rules:
             return None
         for rule in rules:
             origins = rule.get("allowed_origins", [])

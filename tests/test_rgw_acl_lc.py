@@ -131,27 +131,13 @@ def test_acl_over_http(gw):
     SigV4 identities and anonymous requests."""
     access, secret = gw.create_user("webuser")
     port = gw.serve()
-    base = f"http://127.0.0.1:{port}"
 
     def call(method, path, payload=b"", signed=True, headers=None,
              query=None):
-        q = dict(query or {})
-        url = base + path
-        if q:
-            url += "?" + urllib.parse.urlencode(q)
-        req = urllib.request.Request(
-            url, data=payload if payload else None, method=method
+        return _http_call(
+            port, access, secret, method, path, payload=payload,
+            headers=headers, query=query, signed=signed,
         )
-        for k, v in (headers or {}).items():
-            req.add_header(k, v)
-        if signed:
-            for k, v in sign_request(
-                method, path, q, payload, access, secret
-            ).items():
-                req.add_header(k, v)
-        return urllib.request.urlopen(req, timeout=10)
-
-    import urllib.parse
 
     assert call("PUT", "/web").status == 200
     assert call("PUT", "/web/page", payload=b"<html>").status == 200
@@ -251,24 +237,14 @@ def test_sts_temporary_credentials(gw):
 
     access, secret = gw.create_user("stsuser")
     port = gw.serve()
-    base = f"http://127.0.0.1:{port}"
 
     def call(method, path, payload=b"", creds=None, query=None,
              signed=True):
-        q = dict(query or {})
-        url = base + path
-        if q:
-            url += "?" + urllib.parse.urlencode(q)
-        req = urllib.request.Request(
-            url, data=payload or None, method=method
+        a, s = creds or (access, secret)
+        return _http_call(
+            port, a, s, method, path, payload=payload,
+            query=query, signed=signed,
         )
-        if signed:
-            a, s = creds or (access, secret)
-            for k, v in sign_request(
-                method, path, q, payload, a, s
-            ).items():
-                req.add_header(k, v)
-        return urllib.request.urlopen(req, timeout=10)
 
     # anonymous callers cannot mint credentials
     with pytest.raises(urllib.error.HTTPError) as ei:

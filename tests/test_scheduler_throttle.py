@@ -87,12 +87,20 @@ def test_throttle_blocks_fifo_and_get_or_fail():
         assert t.get(amount, timeout=5.0)
         order.append(tag)
 
+    def wait_parked(n, deadline=5.0):
+        # the FIFO claim needs a happens-before: under load a fixed
+        # sleep does NOT guarantee the earlier thread parked first
+        t0 = time.monotonic()
+        while len(t._waiters) < n:
+            assert time.monotonic() - t0 < deadline, "never parked"
+            time.sleep(0.01)
+
     a = threading.Thread(target=taker, args=("first", 6))
     a.start()
-    time.sleep(0.05)
+    wait_parked(1)
     b = threading.Thread(target=taker, args=("second", 1))
     b.start()
-    time.sleep(0.05)
+    wait_parked(2)
     # a small later request must NOT barge past the parked large one
     assert order == []
     t.put(8)  # 0 in flight: first (6) fits, then second (1)
