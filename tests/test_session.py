@@ -73,7 +73,10 @@ def test_session_replays_unacked_after_drop_without_duplicates():
         # tears the connection down instead of transmitting
         cli_msgr.inject_socket_failures = 3
         for i in range(30):
-            sc.call(MPing(from_osd=1, stamp=float(i)), timeout=10.0)
+            # generous per-call budget: every 3rd frame tears the
+            # connection down, and the redial+replay cycles stack up
+            # under CI load
+            sc.call(MPing(from_osd=1, stamp=float(i)), timeout=30.0)
         cli_msgr.inject_socket_failures = 0
         # every ping delivered exactly once, in order
         assert srv.received == [float(i) for i in range(30)]
