@@ -14,13 +14,16 @@ from .perf_counters import (
     PerfCountersBuilder,
     PerfCountersCollection,
 )
+from .tracing import Span, Tracer
 
 __all__ = [
     "AdminSocket",
     "admin_command",
     "Config",
     "OpTracker",
+    "Span",
     "TrackedOp",
+    "Tracer",
     "Option",
     "OPT_BOOL",
     "OPT_FLOAT",

@@ -153,6 +153,38 @@ SCHEMA: dict[str, Option] = {
             True,
             "collect performance counters",
         ),
+        Option(
+            "osd_op_complaint_time",
+            OPT_FLOAT,
+            30.0,
+            "an op in flight longer than this is a SLOW_OPS health "
+            "complaint (osd_op_complaint_time, options.cc)",
+            min=0.0,
+            level=LEVEL_BASIC,
+        ),
+        Option(
+            "mon_slow_op_report_grace",
+            OPT_FLOAT,
+            60.0,
+            "seconds before a daemon's last slow-op report goes "
+            "stale and stops degrading health",
+            min=1.0,
+        ),
+        Option(
+            "tracing_enabled",
+            OPT_BOOL,
+            True,
+            "collect distributed trace spans and push them to the "
+            "mgr tracing module",
+        ),
+        Option(
+            "tracing_max_spans",
+            OPT_INT,
+            2048,
+            "per-daemon bound on buffered finished spans "
+            "(drop-oldest)",
+            min=16,
+        ),
     ]
 }
 
@@ -160,7 +192,9 @@ SCHEMA: dict[str, Option] = {
 _SOURCES = ("default", "file", "env", "runtime", "override")
 
 # harness env vars that share the prefix but are not config options
-_RESERVED_ENV = frozenset({"CEPH_TPU_TEST_PLATFORM"})
+_RESERVED_ENV = frozenset(
+    {"CEPH_TPU_TEST_PLATFORM", "CEPH_TPU_LOCKDEP"}
+)
 
 
 class Config:

@@ -68,6 +68,24 @@ class TrackedOp:
         }
 
 
+def _slowest_stage(op: TrackedOp) -> dict:
+    """The single longest inter-event gap — the stage that made a
+    slow op slow (the ``dump_historic_slow_ops`` view only states the
+    total; the gap names the culprit).  The op's initiation counts as
+    the zeroth event, so a long queue wait before the first mark is
+    attributed too."""
+    prev_t, prev_e = op.initiated_at, "initiated"
+    best = {"event": prev_e, "gap": 0.0}
+    for t, e in op.events:
+        gap = t - prev_t
+        if gap > best["gap"]:
+            # the gap ENDS at this event: it is the wait between
+            # prev_e and e, reported as "prev_e -> e"
+            best = {"event": f"{prev_e} -> {e}", "gap": gap}
+        prev_t, prev_e = t, e
+    return best
+
+
 class OpTracker:
     """history_size/history_duration mirror
     osd_op_history_size/duration's roles."""
@@ -116,7 +134,33 @@ class OpTracker:
                 key=lambda o: o.duration,
                 reverse=True,
             )
-            return {"num_ops": len(ops), "ops": [o.dump() for o in ops]}
+            dumps = []
+            for op in ops:
+                d = op.dump()
+                d["slowest_stage"] = _slowest_stage(op)
+                dumps.append(d)
+            return {"num_ops": len(dumps), "ops": dumps}
+
+    # -- SLOW_OPS watchdog views (OSD::check_ops_in_flight role) -----------
+    def slow_ops(self, threshold: float) -> list[TrackedOp]:
+        """In-flight ops older than ``threshold`` seconds — the
+        osd_op_complaint_time check the health watchdog polls."""
+        now = time.time()
+        with self._lock:
+            return [
+                op
+                for op in self._inflight.values()
+                if now - op.initiated_at >= threshold
+            ]
+
+    def slow_op_summary(self, threshold: float) -> dict:
+        """(count, oldest age) for the mon health report."""
+        slow = self.slow_ops(threshold)
+        now = time.time()
+        oldest = max(
+            (now - op.initiated_at for op in slow), default=0.0
+        )
+        return {"num_slow_ops": len(slow), "oldest_age": oldest}
 
     def register_admin_commands(self, admin_socket) -> None:
         admin_socket.register_command(

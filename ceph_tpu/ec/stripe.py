@@ -62,13 +62,24 @@ class StripeInfo:
         return start, end - start
 
 
+def _kstats():
+    """Lazy: ceph_tpu.ops pulls in the device runtime and registers
+    the jax backend through ceph_tpu.ec — importing it at module
+    scope here would be circular."""
+    from ..ops.kernel_stats import kernel_stats
+
+    return kernel_stats()
+
+
 def encode(
     sinfo: StripeInfo, ec, data: bytes | np.ndarray, want=None
 ) -> dict[int, np.ndarray]:
     """All stripes of ``data`` → per-shard concatenated chunks.
 
     Matrix code families take the batched path: (B, k, chunk) in one
-    device call; others run the reference's per-stripe loop."""
+    device call; others run the reference's per-stripe loop.  Either
+    way the call lands in the ``l_tpu_ec_encode_*`` kernel counters
+    (calls, bytes in/out, sync-bounded latency)."""
     buf = np.frombuffer(bytes(data), dtype=np.uint8) if isinstance(
         data, (bytes, bytearray, memoryview)
     ) else np.ascontiguousarray(data, dtype=np.uint8).ravel()
@@ -84,40 +95,48 @@ def encode(
     if nstripes == 0:
         return {}
 
-    matrix = getattr(ec, "matrix", None)
-    backend = getattr(ec, "backend", None)
-    if (
-        matrix is not None
-        # bitmatrix techniques (cauchy/liberation/blaum_roth) carry a
-        # .matrix too, but encode through XOR schedules over packet
-        # planes — the word-wise matrix path would corrupt them
-        and getattr(ec, "bitmatrix", None) is None
-        and backend is not None
-        and hasattr(backend, "matrix_stripes")
-        and not ec.get_chunk_mapping()
-    ):
-        stripes = buf.reshape(nstripes, k, sinfo.chunk_size)
-        coding = backend.matrix_stripes(matrix, stripes, ec.w)
-        out = {}
-        for i in range(k):
-            if i in want:
-                out[i] = np.ascontiguousarray(stripes[:, i, :]).reshape(-1)
-        for j in range(n - k):
-            if k + j in want:
-                out[k + j] = np.ascontiguousarray(
-                    coding[:, j, :]
-                ).reshape(-1)
+    with _kstats().timed("ec_encode", bytes_in=buf.nbytes) as kt:
+        matrix = getattr(ec, "matrix", None)
+        backend = getattr(ec, "backend", None)
+        if (
+            matrix is not None
+            # bitmatrix techniques (cauchy/liberation/blaum_roth) carry a
+            # .matrix too, but encode through XOR schedules over packet
+            # planes — the word-wise matrix path would corrupt them
+            and getattr(ec, "bitmatrix", None) is None
+            and backend is not None
+            and hasattr(backend, "matrix_stripes")
+            and not ec.get_chunk_mapping()
+        ):
+            stripes = buf.reshape(nstripes, k, sinfo.chunk_size)
+            coding = backend.matrix_stripes(matrix, stripes, ec.w)
+            out = {}
+            for i in range(k):
+                if i in want:
+                    out[i] = np.ascontiguousarray(
+                        stripes[:, i, :]
+                    ).reshape(-1)
+            for j in range(n - k):
+                if k + j in want:
+                    out[k + j] = np.ascontiguousarray(
+                        coding[:, j, :]
+                    ).reshape(-1)
+        else:
+            parts = {i: [] for i in range(n)}
+            for s in range(nstripes):
+                stripe = buf[
+                    s * sinfo.stripe_width : (s + 1) * sinfo.stripe_width
+                ]
+                encoded = ec.encode(set(range(n)), stripe)
+                for i, chunk in encoded.items():
+                    parts[i].append(chunk)
+            out = {
+                i: np.concatenate(p)
+                for i, p in parts.items()
+                if i in want
+            }
+        kt.bytes_out = sum(v.nbytes for v in out.values())
         return out
-
-    out = {i: [] for i in range(n)}
-    for s in range(nstripes):
-        stripe = buf[s * sinfo.stripe_width : (s + 1) * sinfo.stripe_width]
-        encoded = ec.encode(set(range(n)), stripe)
-        for i, chunk in encoded.items():
-            out[i].append(chunk)
-    return {
-        i: np.concatenate(parts) for i, parts in out.items() if i in want
-    }
 
 
 def decode_concat(
@@ -138,14 +157,19 @@ def decode_concat(
         else np.ascontiguousarray(v, dtype=np.uint8)
         for i, v in shards.items()
     }
-    out = []
-    for s in range(nstripes):
-        chunks = {
-            i: v[s * sinfo.chunk_size : (s + 1) * sinfo.chunk_size]
-            for i, v in views.items()
-        }
-        out.append(ec.decode_concat(chunks))
-    return np.concatenate(out)
+    with _kstats().timed(
+        "ec_decode", bytes_in=sum(v.nbytes for v in views.values())
+    ) as kt:
+        out = []
+        for s in range(nstripes):
+            chunks = {
+                i: v[s * sinfo.chunk_size : (s + 1) * sinfo.chunk_size]
+                for i, v in views.items()
+            }
+            out.append(ec.decode_concat(chunks))
+        res = np.concatenate(out)
+        kt.bytes_out = res.nbytes
+        return res
 
 
 class HashInfo:

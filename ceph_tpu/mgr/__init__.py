@@ -22,6 +22,10 @@ Built-in modules (the pybind/mgr counterparts):
   exposition format (ceph_osd_up, ceph_osd_in, ceph_pool_*,
   ceph_pg_total ...), the src/pybind/mgr/prometheus role.
 - ``status`` — health/df rollups for the CLI surface.
+- ``tracing`` — cross-daemon span assembly: drains span batches
+  piggybacked on MMgrReport and serves one logical op's spans from
+  client + primary + replicas as a single tree (the collection half
+  of the blkin/ZTracer role).
 """
 
 from __future__ import annotations
@@ -29,9 +33,12 @@ from __future__ import annotations
 import copy
 import http.server
 import json
+import re
 import threading
 import time
+from collections import OrderedDict, deque
 
+from ..common import tracing
 from ..mon.monitor import MonClient
 from ..msg import Messenger
 from ..msg.message import MMgrReport
@@ -95,6 +102,7 @@ class Manager(Dispatcher):
                 PgAutoscalerModule,
                 TelemetryModule,
                 DashboardModule,
+                TracingModule,
             ]
         )
         self.modules: dict[str, MgrModule] = {}
@@ -103,6 +111,10 @@ class Manager(Dispatcher):
         # DaemonServer role: inbound perf reports, daemon -> (ts, dump)
         self.daemon_perf: dict[str, tuple[float, dict]] = {}
         self._perf_lock = threading.Lock()
+        # span inbox: (daemon, span dicts) batches from MMgrReport,
+        # drained by the tracing module's tick; bounded so a span
+        # firehose with no tracing module cannot grow without limit
+        self._span_inbox: deque[tuple[str, list]] = deque(maxlen=4096)
         self.messenger.add_dispatcher(self)
         self.addr: str | None = None
 
@@ -111,11 +123,18 @@ class Manager(Dispatcher):
         if not isinstance(msg, MMgrReport):
             return False
         try:
+            spans = json.loads(msg.spans)
+        except ValueError:
+            spans = []
+        if spans:
+            self._span_inbox.append((msg.daemon, spans))
+        try:
             dump = json.loads(msg.perf)
         except ValueError:
             return True
-        with self._perf_lock:
-            self.daemon_perf[msg.daemon] = (time.time(), dump)
+        if dump:
+            with self._perf_lock:
+                self.daemon_perf[msg.daemon] = (time.time(), dump)
         return True
 
     def ms_handle_reset(self, conn) -> None:
@@ -354,17 +373,45 @@ class PrometheusModule(MgrModule):
     def shutdown(self) -> None:
         self.server.shutdown()
 
+    # exposition-format hygiene (the prometheus module's
+    # promethize()): metric names allow [a-zA-Z0-9_:], label values
+    # need \ and " escaped
+    _BAD_NAME = re.compile(r"[^a-zA-Z0-9_:]")
+
+    @classmethod
+    def sanitize_name(cls, name: str) -> str:
+        name = cls._BAD_NAME.sub("_", name)
+        if name and name[0].isdigit():
+            name = "_" + name
+        return name
+
+    @staticmethod
+    def escape_label(value: str) -> str:
+        return (
+            str(value)
+            .replace("\\", r"\\")
+            .replace('"', r"\"")
+            .replace("\n", r"\n")
+        )
+
     def render(self) -> str:
         out = []
+        # one HELP/TYPE header per metric FAMILY: prometheus parsers
+        # reject (or silently mis-type) a family whose header arrived
+        # under a different family's name
+        headered: set[str] = set()
 
         def metric(name, value, help_=None, labels=None):
-            if help_:
+            name = self.sanitize_name(name)
+            if help_ and name not in headered:
+                headered.add(name)
                 out.append(f"# HELP {name} {help_}")
                 out.append(f"# TYPE {name} gauge")
             lbl = ""
             if labels:
                 inner = ",".join(
-                    f'{k}="{v}"' for k, v in labels.items()
+                    f'{self.sanitize_name(k)}="{self.escape_label(v)}"'
+                    for k, v in labels.items()
                 )
                 lbl = "{" + inner + "}"
             out.append(f"{name}{lbl} {value}")
@@ -383,49 +430,38 @@ class PrometheusModule(MgrModule):
             metric(
                 "ceph_osd_up",
                 1 if m.is_up(o) else 0,
-                "per-osd up state" if o == 0 else None,
+                "per-osd up state",
                 labels={"ceph_daemon": f"osd.{o}"},
             )
         pg = self.get("pg_summary")
         metric("ceph_pg_total", pg["num_pgs"], "total pgs")
         # per-daemon series from MMgrReport perf dumps (the
         # DaemonServer -> exporter plane): plain counters become
-        # gauges, avgcount/sum pairs become _count/_sum pairs
-        first_perf = True
+        # gauges, avgcount/sum pairs become _count/_sum pairs —
+        # every family gets ITS OWN header, once
         for daemon, dump in sorted(
             (self.get("daemon_perf") or {}).items()
         ):
             for cname, val in sorted(dump.items()):
                 base = "ceph_daemon_" + cname.replace(".", "_")
                 labels = {"ceph_daemon": daemon}
+                help_ = f"per-daemon perf counter {cname}"
                 if isinstance(val, dict) and "avgcount" in val:
                     metric(
-                        base + "_count",
-                        val["avgcount"],
-                        "per-daemon perf counters"
-                        if first_perf
-                        else None,
-                        labels=labels,
+                        base + "_count", val["avgcount"],
+                        help_, labels=labels,
                     )
-                    metric(base + "_sum", val["sum"], labels=labels)
-                    first_perf = False
-                elif isinstance(val, (int, float)):
                     metric(
-                        base,
-                        val,
-                        "per-daemon perf counters"
-                        if first_perf
-                        else None,
-                        labels=labels,
+                        base + "_sum", val["sum"],
+                        help_, labels=labels,
                     )
-                    first_perf = False
+                elif isinstance(val, (int, float)):
+                    metric(base, val, help_, labels=labels)
         for entry in self.get("df")["pools"]:
             metric(
                 "ceph_pool_pg_num",
                 entry["pg_num"],
-                "per-pool pg count"
-                if entry is self.get("df")["pools"][0]
-                else None,
+                "per-pool pg count",
                 labels={"pool": entry["name"]},
             )
         return "\n".join(out) + "\n"
@@ -599,6 +635,105 @@ class DashboardModule(MgrModule):
             f"<th>pg_num</th><th>type</th><th>size</th></tr>{prows}"
             "</table></body></html>"
         )
+
+
+class TracingModule(MgrModule):
+    """Cross-daemon trace assembly (the collection half of the
+    blkin/ZTracer seat; op_tracker.py's docstring promised the
+    correlation, this module delivers it).
+
+    Daemons piggyback drained spans on their MMgrReport pushes; this
+    module drains the manager's span inbox on its tick, indexes spans
+    by trace id, and serves one logical op's spans — from the client,
+    the primary, and every replica/shard — as a single tree
+    (``get_trace``).  Traces are bounded LRU-by-insertion
+    (``max_traces``); a trace stops accepting spans ``trace_ttl``
+    after its first span arrived, so an id reused much later starts a
+    fresh entry instead of gluing two ops together."""
+
+    NAME = "tracing"
+    TICK_EVERY = 0.2
+
+    def __init__(self, mgr: "Manager"):
+        super().__init__(mgr)
+        self.max_traces = int(self.get_module_option("max_traces", 512))
+        self.trace_ttl = float(self.get_module_option("trace_ttl", 600.0))
+        # trace id -> {"first_seen": ts, "spans": [span dicts]}
+        self._traces: OrderedDict[str, dict] = OrderedDict()
+        self._lock = threading.Lock()
+        self.spans_ingested = 0
+
+    def serve(self) -> None:
+        self.ingest_pending()
+
+    def ingest_pending(self) -> None:
+        """Drain the manager's span inbox (callable directly so tests
+        and admin surfaces need not wait a tick)."""
+        while True:
+            try:
+                daemon, spans = self.mgr._span_inbox.popleft()
+            except IndexError:
+                return
+            self._ingest(daemon, spans)
+
+    def _ingest(self, daemon: str, spans: list) -> None:
+        now = time.time()
+        with self._lock:
+            for span in spans:
+                if not isinstance(span, dict) or not span.get("trace_id"):
+                    continue
+                span.setdefault("daemon", daemon)
+                entry = self._traces.get(span["trace_id"])
+                if entry is None:
+                    entry = {"first_seen": now, "spans": []}
+                    self._traces[span["trace_id"]] = entry
+                    while len(self._traces) > self.max_traces:
+                        self._traces.popitem(last=False)
+                elif now - entry["first_seen"] > self.trace_ttl:
+                    entry = {"first_seen": now, "spans": []}
+                    self._traces[span["trace_id"]] = entry
+                entry["spans"].append(span)
+                self.spans_ingested += 1
+
+    # -- query surface -----------------------------------------------------
+    def traces(self) -> list[str]:
+        with self._lock:
+            return list(self._traces)
+
+    def get_trace(self, trace_id: str) -> dict:
+        """One logical op as a span TREE across daemons: explicit
+        parent ids when the spans carry them, role-rank attachment
+        (client < primary < replica/shard) for the cross-daemon hops
+        the wire does not encode."""
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            spans = list(entry["spans"]) if entry else []
+        return {
+            "trace_id": trace_id,
+            "num_spans": len(spans),
+            "daemons": sorted({s.get("daemon", "") for s in spans}),
+            "roots": tracing.assemble_tree(spans),
+        }
+
+    def dump(self) -> dict:
+        """Summary of every held trace (the dump_traces rollup)."""
+        with self._lock:
+            return {
+                "num_traces": len(self._traces),
+                "spans_ingested": self.spans_ingested,
+                "traces": {
+                    tid: {
+                        "num_spans": len(e["spans"]),
+                        "daemons": sorted(
+                            {
+                                s.get("daemon", "")
+                                for s in e["spans"]
+                            }
+                        ),
+                    }
+                    for tid, e in self._traces.items()
+                },
+            }
 
 
 class PgAutoscalerModule(MgrModule):

@@ -121,6 +121,27 @@ class Monitor(Dispatcher):
         self._subs: dict[Connection, int] = {}
         # centralized config database (ConfigMonitor role)
         self.config_db: dict[str, dict[str, str]] = {}
+        # SLOW_OPS reports (HealthMonitor's daemon-health role):
+        # daemon -> (wallclock received, count, oldest_age).  Kept
+        # in-memory per monitor, like mgr beacons — a count of 0
+        # clears; stale reports age out of health after the grace
+        self.slow_ops: dict[str, tuple[float, int, float]] = {}
+
+    def slow_op_report_grace(self) -> float:
+        """mon_slow_op_report_grace: the centralized config database
+        ('ceph config set mon mon_slow_op_report_grace N') overrides
+        the schema default."""
+        raw = self.config_db.get("mon", {}).get(
+            "mon_slow_op_report_grace"
+        )
+        if raw is not None:
+            try:
+                return float(raw)
+            except ValueError:
+                pass
+        from ..common.config import SCHEMA
+
+        return float(SCHEMA["mon_slow_op_report_grace"].default)
 
     # -- commit cycle ------------------------------------------------------
     def commit(self, inc: Incremental) -> int:
@@ -541,7 +562,8 @@ def _cmd_osd_dump(mon: Monitor, cmd: dict) -> MMonCommandReply:
 
 
 def _cmd_health(mon: Monitor, cmd: dict) -> MMonCommandReply:
-    """'ceph health' (HealthMonitor role): DOWN/OUT osds degrade."""
+    """'ceph health' (HealthMonitor role): DOWN/OUT osds and fresh
+    SLOW_OPS reports degrade to WARN."""
     m = mon.osdmap
     down = [o for o in range(m.max_osd) if m.exists(o) and not m.is_up(o)]
     out = [
@@ -553,11 +575,47 @@ def _cmd_health(mon: Monitor, cmd: dict) -> MMonCommandReply:
         checks.append(f"{len(down)} osds down")
     if out:
         checks.append(f"{len(out)} osds out")
+    # SLOW_OPS (the reference's "N slow ops, oldest one blocked for
+    # Ns" health check): fresh nonzero reports only — a crashed
+    # daemon's last report must not pin WARN forever
+    now = time.time()
+    grace = mon.slow_op_report_grace()
+    slow_total, oldest, reporters = 0, 0.0, []
+    for daemon, (ts, count, age) in list(mon.slow_ops.items()):
+        if now - ts > grace:
+            del mon.slow_ops[daemon]
+            continue
+        if count > 0:
+            slow_total += count
+            oldest = max(oldest, age)
+            reporters.append(daemon)
+    if slow_total:
+        checks.append(
+            f"{slow_total} slow ops, oldest one blocked for "
+            f"{oldest:.0f} sec, daemons {sorted(reporters)} have "
+            "slow ops (SLOW_OPS)"
+        )
     status = "HEALTH_OK" if not checks else "HEALTH_WARN"
     return MMonCommandReply(
         outs=status,
         outb=json.dumps({"status": status, "checks": checks}),
     )
+
+
+def _cmd_osd_slow_ops(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """Daemon → mon slow-op report (the OSD SLOW_OPS watchdog's
+    upcall; MOSDBeacon's health payload in the reference).  A count
+    of 0 withdraws the daemon's complaint immediately."""
+    daemon = str(cmd.get("daemon", ""))
+    if not daemon:
+        return MMonCommandReply(rc=-22, outs="missing daemon")
+    count = int(cmd.get("count", 0))
+    oldest = float(cmd.get("oldest_age", 0.0))
+    if count <= 0:
+        mon.slow_ops.pop(daemon, None)
+    else:
+        mon.slow_ops[daemon] = (time.time(), count, oldest)
+    return MMonCommandReply(rc=0, outb=json.dumps({"ok": True}))
 
 
 def _cmd_osd_tree(mon: Monitor, cmd: dict) -> MMonCommandReply:
@@ -1153,6 +1211,7 @@ _COMMANDS = {
     "osd pool ls": _cmd_pool_ls,
     "pg dump": _cmd_pg_dump,
     "health": _cmd_health,
+    "osd slow ops": _cmd_osd_slow_ops,
     "config set": _cmd_config_set,
     "config get": _cmd_config_get,
     "config dump": _cmd_config_dump,

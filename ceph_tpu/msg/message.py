@@ -984,15 +984,23 @@ class MRecoveryReserve(Message):
 class MMgrReport(Message):
     """Daemon → mgr perf-counter report (src/messages/MMgrReport.h
     role): the daemon name plus a JSON perf dump, pushed on the
-    daemon's tick so the mgr's stats plane sees live counters."""
+    daemon's tick so the mgr's stats plane sees live counters.
+
+    ``spans`` piggybacks the daemon's drained trace spans (a JSON
+    list, common/tracing.py shape) on the same report — the mgr
+    ``tracing`` module ingests them, so distributed tracing rides the
+    existing stats plane instead of needing its own session."""
 
     TYPE = 43
     daemon: str = ""
     perf: str = "{}"
+    spans: str = "[]"
 
     def encode_payload(self, e: Encoder) -> None:
-        e.string(self.daemon).string(self.perf)
+        e.string(self.daemon).string(self.perf).string(self.spans)
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MMgrReport":
-        return cls(daemon=d.string(), perf=d.string())
+        return cls(
+            daemon=d.string(), perf=d.string(), spans=d.string()
+        )

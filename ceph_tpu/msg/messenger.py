@@ -519,6 +519,20 @@ class Messenger:
         self._dispatchers.append(d)
 
     def _dispatch(self, conn: Connection, msg: Message) -> None:
+        # trace propagation (the ZTracer trace-info handoff): a
+        # message carrying a span/trace id makes it ambient for its
+        # handlers, so spans they open join the sender's trace
+        # without every handler re-plumbing the id
+        trace = getattr(msg, "trace", "") or getattr(msg, "reqid", "")
+        if trace:
+            from ..common import tracing
+
+            with tracing.propagate(trace):
+                self._dispatch_inner(conn, msg)
+        else:
+            self._dispatch_inner(conn, msg)
+
+    def _dispatch_inner(self, conn: Connection, msg: Message) -> None:
         for d in self._dispatchers:
             try:
                 if d.ms_dispatch(conn, msg):

@@ -21,12 +21,18 @@ from .gf_matmul import (
     gf_matrix_stripes,
     matrix_to_device_bitmatrix,
 )
+from .kernel_stats import kernel_stats
 
 
 def _on_tpu() -> bool:
     import jax
 
-    return jax.default_backend() == "tpu"
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        # a configured-but-unreachable accelerator plugin raises from
+        # the probe itself; that is "no TPU", not a crash
+        return False
 
 
 import functools
@@ -45,7 +51,9 @@ def _host_bitmatrix(key: bytes, shape: tuple, w: int):
 
 def _host_bm(matrix: np.ndarray, w: int):
     mat = np.ascontiguousarray(matrix, dtype=np.int64)
-    return _host_bitmatrix(mat.tobytes(), mat.shape, w)
+    return kernel_stats().counted_cache_call(
+        _host_bitmatrix, mat.tobytes(), mat.shape, w
+    )
 
 
 class JaxBackend:
@@ -54,15 +62,27 @@ class JaxBackend:
     def matrix_regions(
         self, matrix: np.ndarray, regions: np.ndarray, w: int
     ) -> np.ndarray:
-        if w == 8 and _on_tpu() and regions.shape[1] % 4 == 0:
-            bm_np, ok = _host_bm(matrix, w)
-            if ok:
-                return np.asarray(
-                    packed_gf.packed_bitmatrix_regions(bm_np, regions)
-                )
-        bm = matrix_to_device_bitmatrix(matrix, w)
-        out = gf_matrix_regions(bm, jnp.asarray(regions), w=w)
-        return np.asarray(out)
+        # np.asarray inside the timer forces the device sync, so the
+        # recorded latency is the kernel, not the dispatch
+        with kernel_stats().timed(
+            "gf_matmul", bytes_in=regions.nbytes
+        ) as kt:
+            if w == 8 and _on_tpu() and regions.shape[1] % 4 == 0:
+                bm_np, ok = _host_bm(matrix, w)
+                if ok:
+                    out = np.asarray(
+                        packed_gf.packed_bitmatrix_regions(
+                            bm_np, regions
+                        )
+                    )
+                    kt.bytes_out = out.nbytes
+                    return out
+            bm = matrix_to_device_bitmatrix(matrix, w)
+            out = np.asarray(
+                gf_matrix_regions(bm, jnp.asarray(regions), w=w)
+            )
+            kt.bytes_out = out.nbytes
+            return out
 
     def bitmatrix_regions(
         self,
@@ -71,13 +91,19 @@ class JaxBackend:
         w: int,
         packetsize: int,
     ) -> np.ndarray:
-        out = bitmatrix_packet_regions(
-            jnp.asarray(bm, dtype=jnp.int8),
-            jnp.asarray(regions),
-            w=w,
-            packetsize=packetsize,
-        )
-        return np.asarray(out)
+        with kernel_stats().timed(
+            "gf_bitmatrix", bytes_in=regions.nbytes
+        ) as kt:
+            out = np.asarray(
+                bitmatrix_packet_regions(
+                    jnp.asarray(bm, dtype=jnp.int8),
+                    jnp.asarray(regions),
+                    w=w,
+                    packetsize=packetsize,
+                )
+            )
+            kt.bytes_out = out.nbytes
+            return out
 
     def matrix_stripes(
         self, matrix: np.ndarray, stripes, w: int
@@ -89,14 +115,23 @@ class JaxBackend:
         ``ops.packed_gf.packed_matrix_stripes``) directly instead."""
         stripes = np.ascontiguousarray(stripes, dtype=np.uint8)
         b, _k, chunk = stripes.shape
-        if w == 8 and _on_tpu() and (b * chunk) % 4 == 0:
-            bm_np, ok = _host_bm(matrix, w)
-            if ok:
-                return np.asarray(
-                    packed_gf.packed_matrix_stripes(bm_np, stripes)
-                )
-        bm = matrix_to_device_bitmatrix(matrix, w)
-        return np.asarray(gf_matrix_stripes(bm, jnp.asarray(stripes), w=w))
+        with kernel_stats().timed(
+            "gf_matmul", bytes_in=stripes.nbytes
+        ) as kt:
+            if w == 8 and _on_tpu() and (b * chunk) % 4 == 0:
+                bm_np, ok = _host_bm(matrix, w)
+                if ok:
+                    out = np.asarray(
+                        packed_gf.packed_matrix_stripes(bm_np, stripes)
+                    )
+                    kt.bytes_out = out.nbytes
+                    return out
+            bm = matrix_to_device_bitmatrix(matrix, w)
+            out = np.asarray(
+                gf_matrix_stripes(bm, jnp.asarray(stripes), w=w)
+            )
+            kt.bytes_out = out.nbytes
+            return out
 
 
 _backend = JaxBackend()

@@ -118,5 +118,9 @@ def matrix_to_device_bitmatrix(
     distinct (matrix, dtype) (the analog of ErasureCodeIsaTableCache's
     one-time per-erasure-signature table preparation).  dtype jnp.int8
     for the XLA int-matmul path, jnp.bfloat16 for the pallas kernel."""
+    from .kernel_stats import kernel_stats
+
     mat = np.ascontiguousarray(matrix, dtype=np.int64)
-    return _bitmatrix_cache(mat.tobytes(), mat.shape, w, dtype)
+    return kernel_stats().counted_cache_call(
+        _bitmatrix_cache, mat.tobytes(), mat.shape, w, dtype
+    )

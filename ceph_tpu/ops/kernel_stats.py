@@ -1,0 +1,175 @@
+"""Device-kernel telemetry — the perf-counter plane for the TPU hot
+paths (the l_osd_* PerfCounters idiom, src/common/perf_counters.h,
+applied to the device kernels the paper pins its metrics on).
+
+One process-global ``PerfCounters`` set named ``tpu_kernels`` holds a
+counter group per kernel entry point:
+
+    l_tpu_<group>_calls      u64   kernel invocations
+    l_tpu_<group>_bytes_in   u64   input bytes handed to the device
+    l_tpu_<group>_bytes_out  u64   output bytes produced
+    l_tpu_<group>_lat        time  wall latency (device-sync bounded:
+                                   callers time through the
+                                   np.asarray/block_until_ready sync)
+
+plus the compile-cache counters:
+
+    l_tpu_compile_cache_hit / l_tpu_compile_cache_miss
+
+Groups registered by the instrumented modules: ``ec_encode`` /
+``ec_decode`` (ec/stripe.py batched seam), ``gf_matmul`` /
+``gf_bitmatrix`` (ops/ec_backend.py device dispatch), ``crush``
+(osd/mapping.py batched PG mapping, where bytes_in counts PGs mapped
+via the extra ``l_tpu_crush_pgs`` counter).
+
+The set is a normal PerfCounters: daemons register it on their admin
+socket collection (``perf dump``) and merge its dump into their
+MMgrReport, so kernel telemetry flows through the existing
+perf dump → MMgrReport → /metrics pipeline with no new plumbing.
+Being process-global, co-hosted daemons (the test MiniCluster) share
+one set — each reports the same process-wide kernel counters, the
+same way they share the one JAX runtime.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..common.perf_counters import (
+    PERFCOUNTER_TIME,
+    PERFCOUNTER_U64,
+    PerfCounters,
+    _Counter,
+)
+
+
+class KernelStats:
+    def __init__(self, name: str = "tpu_kernels"):
+        self.perf = PerfCounters(name)
+        self._lock = threading.Lock()
+        self._cache_call_lock = threading.Lock()
+        self._groups: set[str] = set()
+        self._ensure_counter("l_tpu_compile_cache_hit", PERFCOUNTER_U64,
+                             "device bitmatrix/table cache hits")
+        self._ensure_counter("l_tpu_compile_cache_miss", PERFCOUNTER_U64,
+                             "device bitmatrix/table cache misses")
+
+    def _ensure_counter(self, name: str, kind: str, desc: str) -> None:
+        with self.perf._lock:
+            if name not in self.perf._counters:
+                self.perf._counters[name] = _Counter(name, kind, desc)
+
+    def _ensure_group(self, group: str) -> None:
+        with self._lock:
+            if group in self._groups:
+                return
+            base = f"l_tpu_{group}"
+            self._ensure_counter(
+                f"{base}_calls", PERFCOUNTER_U64, f"{group} kernel calls"
+            )
+            self._ensure_counter(
+                f"{base}_bytes_in", PERFCOUNTER_U64, f"{group} input bytes"
+            )
+            self._ensure_counter(
+                f"{base}_bytes_out", PERFCOUNTER_U64, f"{group} output bytes"
+            )
+            self._ensure_counter(
+                f"{base}_lat", PERFCOUNTER_TIME, f"{group} kernel latency"
+            )
+            self._groups.add(group)
+
+    # -- recording ---------------------------------------------------------
+    def record(
+        self,
+        group: str,
+        bytes_in: int = 0,
+        bytes_out: int = 0,
+        seconds: float = 0.0,
+    ) -> None:
+        self._ensure_group(group)
+        base = f"l_tpu_{group}"
+        self.perf.inc(f"{base}_calls")
+        if bytes_in:
+            self.perf.inc(f"{base}_bytes_in", int(bytes_in))
+        if bytes_out:
+            self.perf.inc(f"{base}_bytes_out", int(bytes_out))
+        self.perf.tinc(f"{base}_lat", seconds)
+
+    def record_cache(self, hits: int, misses: int) -> None:
+        if hits:
+            self.perf.inc("l_tpu_compile_cache_hit", hits)
+        if misses:
+            self.perf.inc("l_tpu_compile_cache_miss", misses)
+
+    def counted_cache_call(self, cached_fn, *args):
+        """Call an ``functools.lru_cache``-wrapped function and record
+        the hit/miss it produced.  The snapshot-call-snapshot runs
+        under one lock so concurrent callers cannot double- or
+        zero-count against the shared cache_info (misses — the
+        expensive bitmatrix builds — serialize; hits are dict
+        lookups, so the lock is cheap where it matters)."""
+        with self._cache_call_lock:
+            before = cached_fn.cache_info()
+            out = cached_fn(*args)
+            after = cached_fn.cache_info()
+            self.record_cache(
+                after.hits - before.hits, after.misses - before.misses
+            )
+        return out
+
+    def counter(self, group: str, suffix: str, kind=PERFCOUNTER_U64,
+                desc: str = ""):
+        """Register an extra per-group counter (e.g. crush's
+        l_tpu_crush_pgs) and return its full name."""
+        name = f"l_tpu_{group}_{suffix}"
+        self._ensure_counter(name, kind, desc)
+        return name
+
+    def timed(self, group: str, bytes_in: int = 0):
+        """Context manager timing one kernel call; the caller must
+        sync the device inside the block (np.asarray /
+        block_until_ready) so the latency is real, not dispatch."""
+        return _KernelTimer(self, group, bytes_in)
+
+    def dump(self) -> dict:
+        return self.perf.dump()
+
+
+class _KernelTimer:
+    __slots__ = ("_ks", "_group", "_bytes_in", "bytes_out", "_t0")
+
+    def __init__(self, ks: KernelStats, group: str, bytes_in: int):
+        self._ks = ks
+        self._group = group
+        self._bytes_in = bytes_in
+        self.bytes_out = 0
+
+    def __enter__(self) -> "_KernelTimer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, *exc) -> bool:
+        if exc_type is None:
+            self._ks.record(
+                self._group,
+                bytes_in=self._bytes_in,
+                bytes_out=self.bytes_out,
+                seconds=time.perf_counter() - self._t0,
+            )
+        return False
+
+
+_instance: KernelStats | None = None
+_instance_lock = threading.Lock()
+
+
+def kernel_stats() -> KernelStats:
+    """The process-global collector (like the one JAX runtime the
+    kernels themselves share)."""
+    global _instance
+    if _instance is None:
+        with _instance_lock:
+            if _instance is None:
+                _instance = KernelStats()
+    return _instance

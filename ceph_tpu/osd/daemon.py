@@ -83,6 +83,7 @@ from ..msg import (
 )
 from dataclasses import dataclass
 
+from ..common import tracing
 from ..common.perf_counters import PerfCountersBuilder
 from ..common.throttle import Throttle
 from .scheduler import (
@@ -237,6 +238,27 @@ class _RecoveryOp:
     failed: bool = False
 
 
+def build_osd_perf(whoami: int):
+    """The OSD's counter schema (the l_osd_* declaration block,
+    OSD.cc:9681) — module-level so tools/check_metrics.py can lint
+    it without constructing a daemon."""
+    return (
+        PerfCountersBuilder(f"osd.{whoami}")
+        .add_u64_counter("op", "client ops")
+        .add_u64_counter("op_r", "client reads")
+        .add_u64_counter("op_w", "client mutations")
+        .add_time_avg("op_latency", "client op latency")
+        .add_u64_gauge("numpg", "hosted pgs")
+        .add_u64_gauge("recovery_active", "in-flight recovery pushes")
+        .add_u64_counter("tier_flush", "cache-tier agent flushes")
+        .add_u64_counter("tier_evict", "cache-tier agent evictions")
+        .add_u64_gauge(
+            "slow_ops", "in-flight ops past the complaint time"
+        )
+        .create_perf_counters()
+    )
+
+
 class OSD(Dispatcher):
     def __init__(
         self,
@@ -299,18 +321,33 @@ class OSD(Dispatcher):
         # blkin/ZTracer seat): every client op registers under its
         # reqid; every sub-op carries that reqid as its trace, so
         # dump_historic_ops on two daemons correlates one op
-        from ..common import AdminSocket, OpTracker
+        from ..common import AdminSocket, Config, OpTracker
+        from ..common.config import ConfigError
 
+        self.config = Config()
+        try:
+            self.config.parse_env()
+        except ConfigError as e:
+            # a stray CEPH_TPU_* env var must not kill the daemon
+            dout(0, f"osd.{whoami}: ignoring bad env config: {e}")
         self.op_tracker = OpTracker()
+        # distributed tracing (common/tracing.py): per-stage spans
+        # under the client reqid, drained onto the MMgrReport push
+        self.tracer = tracing.Tracer(
+            f"osd.{whoami}",
+            max_spans=int(self.config.get("tracing_max_spans")),
+        )
         self.admin = None
         if admin_socket_path:
             self.admin = AdminSocket(
-                str(admin_socket_path), perf=None
+                str(admin_socket_path), config=self.config
             )
             self.op_tracker.register_admin_commands(self.admin)
+            self.tracer.register_admin_commands(self.admin)
             self.admin.start()
         self._shard_server = ShardServer(
-            self.store, whoami, tracker=self.op_tracker
+            self.store, whoami,
+            tracker=self.op_tracker, tracer=self.tracer,
         )
         # watch/notify (PrimaryLogPG watchers + Notify machinery):
         # watchers are in-memory per primary — clients re-register via
@@ -327,18 +364,18 @@ class OSD(Dispatcher):
         self.recovery_active_peak = 0  # high-water mark (perf gauge)
         # daemon perf counters (l_osd_* role): pushed to the mgr as
         # MMgrReport on the tick (the DaemonServer stats plane)
-        self.perf = (
-            PerfCountersBuilder(f"osd.{whoami}")
-            .add_u64_counter("op", "client ops")
-            .add_u64_counter("op_r", "client reads")
-            .add_u64_counter("op_w", "client mutations")
-            .add_time_avg("op_latency", "client op latency")
-            .add_u64_gauge("numpg", "hosted pgs")
-            .add_u64_gauge("recovery_active", "in-flight recovery pushes")
-            .add_u64_counter("tier_flush", "cache-tier agent flushes")
-            .add_u64_counter("tier_evict", "cache-tier agent evictions")
-            .create_perf_counters()
-        )
+        self.perf = build_osd_perf(whoami)
+        if self.admin is not None:
+            # `perf dump` over the admin socket serves the daemon's
+            # counters AND the process-global device-kernel plane
+            from ..ops.kernel_stats import kernel_stats
+
+            self.admin.perf.add(self.perf)
+            self.admin.perf.add(kernel_stats().perf)
+        # SLOW_OPS watchdog state (osd_op_complaint_time): last count
+        # reported to the mon + report throttle stamp
+        self._slow_ops_last_report = 0.0
+        self._slow_ops_reported = 0
         self._mgr_addr: str | None = None
         self._mgr_conn = None
         self._mgr_addr_checked = 0.0
@@ -1046,8 +1083,18 @@ class OSD(Dispatcher):
         )
         top.mark_event("started")
         self._cur_op = top
+        # primary-side span under the client's trace (= reqid): the
+        # `with` installs it as this worker thread's ambient, so the
+        # store layers' per-stage spans attach as children
+        span = self.tracer.start_span(
+            "osd_op",
+            trace_id=msg.reqid or "",
+            role=tracing.ROLE_PRIMARY,
+            tags={"pgid": msg.pgid, "oid": msg.oid, "op": msg.op},
+        )
         try:
-            self._handle_op_inner(conn, msg)
+            with span:
+                self._handle_op_inner(conn, msg)
         finally:
             self._cur_op = None
             top.finish()
@@ -1756,6 +1803,9 @@ class OSD(Dispatcher):
                 continue
             if self._cur_op is not None:
                 self._cur_op.mark_event(f"sub_op_sent osd.{osd}")
+            tracing.current_span().mark_event(
+                f"sub_op_sent osd.{osd}"
+            )
             try:
                 ack = self._peer_conn(osd).call(
                     MOSDRepOp(
@@ -1766,8 +1816,12 @@ class OSD(Dispatcher):
                 )
                 if isinstance(ack, MOSDRepOpReply) and not ack.ok:
                     failed.append(osd)
-                elif self._cur_op is not None:
-                    self._cur_op.mark_event(
+                else:
+                    if self._cur_op is not None:
+                        self._cur_op.mark_event(
+                            f"sub_op_commit_rec osd.{osd}"
+                        )
+                    tracing.current_span().mark_event(
                         f"sub_op_commit_rec osd.{osd}"
                     )
             except (MessageError, OSError):
@@ -2037,6 +2091,12 @@ class OSD(Dispatcher):
         top = self.op_tracker.create_op(
             f"rep_op({msg.trace} {msg.pgid})", trace=msg.trace
         )
+        span = self.tracer.start_span(
+            "rep_op",
+            trace_id=msg.trace or "",
+            role=tracing.ROLE_REPLICA,
+            tags={"pgid": msg.pgid},
+        )
         if pg is None or pg.activated_epoch == 0:
             # an unactivated replica must not splice mid-stream
             # entries into an empty log (its hole-filled log could
@@ -2045,6 +2105,8 @@ class OSD(Dispatcher):
             reply.error = "pg not activated (-EAGAIN)"
             top.mark_event("rejected: pg not activated")
             top.finish()
+            span.mark_event("rejected: pg not activated")
+            span.finish()
             conn.send(reply)
             return
         try:
@@ -2062,6 +2124,8 @@ class OSD(Dispatcher):
             reply.error = str(e)
         top.mark_event("applied" if reply.ok else "failed")
         top.finish()
+        span.mark_event("applied" if reply.ok else "failed")
+        span.finish()
         conn.send(reply)
 
     def _handle_query(self, conn: Connection, msg: MPGQuery) -> None:
@@ -2546,10 +2610,23 @@ class OSD(Dispatcher):
                 self._mgr_conn = self.messenger.connect(
                     host, int(port), timeout=5.0
                 )
+            # device-kernel counters (ops/kernel_stats.py) merge into
+            # the same flat dump, so `l_tpu_*` series ride the
+            # existing perf dump → MMgrReport → /metrics pipeline
+            from ..ops.kernel_stats import kernel_stats
+
+            dump = dict(self.perf.dump())
+            dump.update(kernel_stats().dump())
+            spans = (
+                self.tracer.drain()
+                if self.config.get("tracing_enabled")
+                else []
+            )
             self._mgr_conn.send(
                 MMgrReport(
                     daemon=f"osd.{self.whoami}",
-                    perf=json.dumps(self.perf.dump()),
+                    perf=json.dumps(dump),
+                    spans=json.dumps(spans),
                 )
             )
         except (MessageError, OSError, ValueError):
@@ -3093,3 +3170,35 @@ class OSD(Dispatcher):
                     self._reported.add(osd)
                 except (MessageError, OSError):
                     pass
+            self._check_slow_ops(now)
+
+    def _check_slow_ops(self, now: float) -> None:
+        """SLOW_OPS watchdog (OSD::check_ops_in_flight →
+        get_health_metrics): in-flight ops older than
+        osd_op_complaint_time degrade mon health; a report of 0
+        clears our complaint.  Reports are throttled to ~1/s and only
+        sent on a change or while nonzero (refreshing the mon's
+        staleness grace)."""
+        if now - self._slow_ops_last_report < 1.0:
+            return
+        try:
+            threshold = float(
+                self.config.get("osd_op_complaint_time")
+            )
+            summary = self.op_tracker.slow_op_summary(threshold)
+            count = summary["num_slow_ops"]
+            self.perf.set("slow_ops", count)
+            if count == 0 and self._slow_ops_reported == 0:
+                return
+            self._slow_ops_last_report = now
+            self.monc.command(
+                {
+                    "prefix": "osd slow ops",
+                    "daemon": f"osd.{self.whoami}",
+                    "count": count,
+                    "oldest_age": summary["oldest_age"],
+                }
+            )
+            self._slow_ops_reported = count
+        except (MessageError, OSError, ValueError):
+            pass

@@ -89,13 +89,23 @@ class OSDMapMapping:
     def _update_pool(
         self, osdmap: OSDMap, pool: PgPool, use_device: bool
     ) -> None:
+        from ..ops.kernel_stats import kernel_stats
+
         n = pool.pg_num
         size = pool.size
         ps = np.arange(n, dtype=np.int64)
         pps = pool_pps_vec(pool, ps).astype(np.int64)
 
-        with self.perf.time_it("crush_stage"):
+        ks = kernel_stats()
+        pgs_counter = ks.counter(
+            "crush", "pgs", desc="PGs mapped through the CRUSH kernel"
+        )
+        with self.perf.time_it("crush_stage"), ks.timed(
+            "crush", bytes_in=pps.nbytes
+        ) as kt:
             raw = self._crush_stage(osdmap, pool, pps, use_device)
+            kt.bytes_out = raw.nbytes
+        ks.perf.inc(pgs_counter, n)
 
         with self.perf.time_it("fixup_stages"):
             up, up_primary, acting, acting_primary = self._fixup(

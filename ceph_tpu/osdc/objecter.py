@@ -18,6 +18,7 @@ import itertools
 import os
 import time
 
+from ..common import tracing
 from ..crush.hashing import ceph_str_hash_rjenkins
 from ..msg import Messenger, MessageError, MOSDOp, MOSDOpReply
 from ..msg.messenger import Connection
@@ -63,6 +64,12 @@ class Objecter:
         # on every map change so a new primary learns the watchers
         self._lingers: dict[int, tuple[int, str]] = {}  # cookie → (pool, oid)
         self._linger_epoch = 0
+        # distributed tracing: the objecter opens the ROOT span of
+        # every logical op (trace id = reqid, the id every sub-op
+        # message already carries); spans buffer here until
+        # flush_spans_to_mgr ships them on the MMgrReport path
+        self.tracer = tracing.Tracer(f"client.{self._client_id}")
+        self._mgr_addr: str | None = None
 
     def new_identity(self) -> None:
         """Adopt a fresh client id (the daemon-respawn analog): a
@@ -164,6 +171,25 @@ class Objecter:
         deadline = time.monotonic() + self.op_timeout
         last_err = "no attempt"
         reqid = f"{self._client_id}.{next(self._op_seq)}"
+        root = self.tracer.start_span(
+            "client_op",
+            trace_id=reqid,
+            role=tracing.ROLE_CLIENT,
+            tags={"pool": pool_id, "oid": oid, "op": op},
+        )
+        with root:
+            return self._op_submit_attempts(
+                root, deadline, last_err, reqid, pool_id, oid,
+                op, offset, length, data, attr, pgid, snapid,
+                snap_seq, is_read,
+            )
+
+    def _op_submit_attempts(
+        self, root, deadline, last_err, reqid, pool_id, oid, op,
+        offset, length, data, attr, pgid, snapid, snap_seq, is_read,
+    ) -> MOSDOpReply:
+        from ..msg.message import OSD_OP_LIST
+
         while time.monotonic() < deadline:
             try:
                 # re-resolve the tier overlay every attempt: a map
@@ -184,6 +210,7 @@ class Objecter:
                 )
                 if primary < 0:
                     raise MessageError("pg has no primary (all down?)")
+                root.mark_event(f"send_op osd.{primary} pg {tgt_pgid}")
                 reply = self._conn_to(primary).call(
                     MOSDOp(
                         pool=eff_pool, pgid=tgt_pgid, oid=oid, op=op,
@@ -195,9 +222,11 @@ class Objecter:
                 )
                 assert isinstance(reply, MOSDOpReply)
                 if reply.ok:
+                    root.mark_event("reply_ok")
                     return reply
                 if "EAGAIN" in reply.error:
                     last_err = reply.error
+                    root.mark_event("retry: EAGAIN")
                     # stale target / peering: wait for map movement
                     time.sleep(0.1)
                     continue
@@ -220,3 +249,40 @@ class Objecter:
             int(pool_id), int(ps)
         )
         return primary
+
+    # -- span delivery (the client half of the tracing plane) --------------
+    def flush_spans_to_mgr(self) -> int:
+        """Ship buffered client spans to the active mgr as an
+        MMgrReport (perf stays empty — the spans piggyback exactly
+        like the daemons').  Best-effort: no mgr, no spans, no error.
+        Returns the number of spans shipped."""
+        import json
+
+        from ..msg.message import MMgrReport
+
+        spans = self.tracer.drain()
+        if not spans:
+            return 0
+        try:
+            if self._mgr_addr is None:
+                reply = self.monc.command({"prefix": "mgr stat"})
+                active = (
+                    json.loads(reply.outb).get("active")
+                    if reply.rc == 0
+                    else None
+                )
+                self._mgr_addr = active["addr"] if active else None
+            if self._mgr_addr is None:
+                return 0
+            host, _, port = self._mgr_addr.rpartition(":")
+            conn = self.messenger.connect(host, int(port), timeout=5.0)
+            conn.send(
+                MMgrReport(
+                    daemon=f"client.{self._client_id}",
+                    spans=json.dumps(spans),
+                )
+            )
+            return len(spans)
+        except (MessageError, OSError, ValueError, KeyError):
+            self._mgr_addr = None
+            return 0

@@ -149,10 +149,18 @@ class ECStore:
             self._put_inner(name, data)
 
     def _put_inner(self, name: str, data: bytes) -> None:
+        from ..common import tracing
+
         logical = len(data)
         padded_len = self.sinfo.logical_to_next_stripe_offset(logical)
         padded = data + b"\0" * (padded_len - logical)
-        shards = stripe_encode(self.sinfo, self.ec, padded)
+        # per-stage child spans under the ambient daemon op: the
+        # device encode and the shard fan-out are the two stages a
+        # slow EC write can hide in
+        with tracing.span(
+            "ec_encode", tags={"oid": name, "size": logical}
+        ):
+            shards = stripe_encode(self.sinfo, self.ec, padded)
         if not shards:  # zero-length object: n empty shards
             shards = {
                 i: np.zeros(0, dtype=np.uint8) for i in range(self.n)
@@ -169,8 +177,12 @@ class ECStore:
         # encoding two different logical states
         ticket = self._enter(name)
         try:
-            for i, store in enumerate(self.stores):
-                self._write_shard(store, name, bytes(shards[i]), meta)
+            with tracing.span("ec_shard_writes", tags={"oid": name}) as sp:
+                for i, store in enumerate(self.stores):
+                    self._write_shard(
+                        store, name, bytes(shards[i]), meta
+                    )
+                    sp.mark_event(f"shard_{i}_applied")
         finally:
             # queued RMW ops must not reuse stripes of the replaced
             # content — even when a shard write failed partway, the
@@ -370,22 +382,29 @@ class ECStore:
         the k data shards; any failure widens to every shard.  Reads
         order through the per-object ticket queue so they never observe
         a half-landed multi-shard write."""
+        from ..common import tracing
+
         ticket = self._enter(name)
         try:
-            meta = self._shard_meta(name)
-            if meta["size"] == 0:
-                return b""
-            want = {self.ec.chunk_index(i) for i in range(self.k)}
-            chunks = self._gather(name, meta, want)
-            if set(chunks) != want:
-                # reconstruct path: top up with the shards not yet read
-                chunks.update(
-                    self._gather(
-                        name, meta, set(range(self.n)) - set(chunks)
+            with tracing.span("ec_read", tags={"oid": name}) as sp:
+                meta = self._shard_meta(name)
+                if meta["size"] == 0:
+                    return b""
+                want = {self.ec.chunk_index(i) for i in range(self.k)}
+                chunks = self._gather(name, meta, want)
+                if set(chunks) != want:
+                    # reconstruct path: top up with the shards not
+                    # yet read
+                    sp.mark_event("widen_to_reconstruct")
+                    chunks.update(
+                        self._gather(
+                            name, meta,
+                            set(range(self.n)) - set(chunks),
+                        )
                     )
-                )
-            data = decode_concat(self.sinfo, self.ec, chunks)
-            return bytes(data[: meta["size"]])
+                sp.mark_event("shards_gathered")
+                data = decode_concat(self.sinfo, self.ec, chunks)
+                return bytes(data[: meta["size"]])
         finally:
             self._exit(name, ticket)
 

@@ -81,10 +81,12 @@ class ShardServer(Dispatcher):
         store: ObjectStore | None = None,
         whoami: int = 0,
         tracker=None,
+        tracer=None,
     ):
         self.store = store or MemStore()
         self.whoami = whoami
         self.tracker = tracker  # OpTracker: sub-ops record their span
+        self.tracer = tracer  # common.tracing.Tracer (optional)
 
     def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
         if isinstance(msg, MECSubWrite):
@@ -96,6 +98,15 @@ class ShardServer(Dispatcher):
                 top = self.tracker.create_op(
                     f"ec_sub_write({msg.trace})", trace=msg.trace
                 )
+            span = None
+            if self.tracer is not None and msg.trace:
+                from ..common.tracing import ROLE_SHARD
+
+                span = self.tracer.start_span(
+                    "ec_sub_write",
+                    trace_id=msg.trace,
+                    role=ROLE_SHARD,
+                )
             try:
                 self.store.queue_transaction(msg.txn)
             except StoreError as e:
@@ -104,6 +115,9 @@ class ShardServer(Dispatcher):
             if top is not None:
                 top.mark_event("applied" if reply.ok else "failed")
                 top.finish()
+            if span is not None:
+                span.mark_event("applied" if reply.ok else "failed")
+                span.finish()
             conn.send(reply)
             return True
         if isinstance(msg, MECSubRead):

@@ -30,6 +30,7 @@ import json
 import threading
 from collections import Counter
 
+from ..common import tracing
 from ..native import ceph_crc32c
 from .objectstore import MemStore, ObjectStore, StoreError, Transaction
 from .pg_util import ObjectOpQueue, ScrubResult
@@ -87,15 +88,24 @@ class ReplicatedStore:
         }
         ticket = self._enter(name)
         try:
-            for store in self.stores:
-                txn = Transaction()
-                if store.exists(self.cid, name):
-                    txn.remove(self.cid, name)
-                txn.touch(self.cid, name)
-                if data:
-                    txn.write(self.cid, name, 0, data)
-                txn.setattr(self.cid, name, INFO_KEY, json.dumps(meta).encode())
-                store.queue_transaction(txn)
+            # per-stage child span under the ambient daemon op (the
+            # sub_op_applied stages of the replicated write)
+            with tracing.span(
+                "rep_put", tags={"oid": name, "size": len(data)}
+            ) as sp:
+                for i, store in enumerate(self.stores):
+                    txn = Transaction()
+                    if store.exists(self.cid, name):
+                        txn.remove(self.cid, name)
+                    txn.touch(self.cid, name)
+                    if data:
+                        txn.write(self.cid, name, 0, data)
+                    txn.setattr(
+                        self.cid, name, INFO_KEY,
+                        json.dumps(meta).encode(),
+                    )
+                    store.queue_transaction(txn)
+                    sp.mark_event(f"replica_{i}_applied")
         finally:
             self._exit(name, ticket)
 
@@ -170,15 +180,17 @@ class ReplicatedStore:
         attributes it and recovery repairs it."""
         ticket = self._enter(name)
         try:
-            meta = self._meta(name)
-            for replica in range(self.size):
-                raw = self._read_verified(name, meta, replica)
-                if raw is not None:
-                    return raw
-                self._flag_repair(name, replica)
-            raise StoreError(
-                f"object {name}: no verifiable replica (-EIO)"
-            )
+            with tracing.span("rep_get", tags={"oid": name}) as sp:
+                meta = self._meta(name)
+                for replica in range(self.size):
+                    raw = self._read_verified(name, meta, replica)
+                    if raw is not None:
+                        return raw
+                    sp.mark_event(f"replica_{replica}_fallback")
+                    self._flag_repair(name, replica)
+                raise StoreError(
+                    f"object {name}: no verifiable replica (-EIO)"
+                )
         finally:
             self._exit(name, ticket)
 

@@ -65,7 +65,8 @@ def _sample_messages():
         "MClientReply": M.MClientReply(rc=0, outs="", outb='{"ino": 5}'),
         "MClientCaps": M.MClientCaps(action="revoke", ino=77),
         "MMgrReport": M.MMgrReport(
-            daemon="osd.1", perf='{"op": 4}'
+            daemon="osd.1", perf='{"op": 4}',
+            spans='[{"trace_id": "t", "span_id": "s"}]',
         ),
     }
     for name, msg in samples.items():

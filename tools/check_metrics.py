@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Metrics-schema lint — walk every registered PerfCounters schema
+and fail on exporter-breaking declarations (run in tier-1 via
+tests/test_observability.py, and standalone as
+``python tools/check_metrics.py``).
+
+Checks, per counter set:
+
+- duplicate counter names within a set (the builder asserts at
+  declaration time; dynamically-extended sets — KernelStats — can
+  bypass it) and duplicate (set, counter) pairs across sets after the
+  exporter's name transformation;
+- names that the Prometheus exposition format rejects: anything
+  outside ``[a-zA-Z_:][a-zA-Z0-9_:]*`` AFTER the mgr exporter's
+  sanitization would silently collide or be dropped — the lint flags
+  the raw name so the collision is fixed at the source;
+- histogram counters with no bucket bounds (an unbounded histogram
+  dumps an empty bucket array and renders as a zero-information
+  series).
+
+The walked schemas are the product's real ones: the OSD daemon's
+counter block, the batched-mapping counters, and the device-kernel
+telemetry plane (after forcing registration of every group).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def check_perf_counters(pc) -> list[str]:
+    """Lint one PerfCounters set; returns human-readable errors."""
+    from ceph_tpu.common.perf_counters import PERFCOUNTER_HISTOGRAM
+
+    errors: list[str] = []
+    seen: set[str] = set()
+    for name, counter in pc._counters.items():
+        where = f"{pc.name}.{name}"
+        if name in seen:
+            errors.append(f"{where}: duplicate counter name")
+        seen.add(name)
+        if counter.name != name:
+            errors.append(
+                f"{where}: registered under {counter.name!r}"
+            )
+        if not _NAME_RE.match(name.replace(".", "_")):
+            errors.append(
+                f"{where}: invalid Prometheus metric characters"
+            )
+        if counter.kind == PERFCOUNTER_HISTOGRAM and not list(
+            counter.bucket_bounds
+        ):
+            errors.append(
+                f"{where}: histogram with no bucket bounds"
+            )
+    if not _NAME_RE.match(pc.name.replace(".", "_")):
+        errors.append(
+            f"{pc.name}: set name has invalid Prometheus characters"
+        )
+    return errors
+
+
+def product_counter_sets():
+    """Every schema the product registers (import side effects force
+    lazy groups into existence so the lint sees the real shape)."""
+    from ceph_tpu.ops.kernel_stats import KernelStats
+    from ceph_tpu.osd.daemon import build_osd_perf
+    from ceph_tpu.osd.mapping import _build_perf as build_mapping_perf
+
+    ks = KernelStats()
+    # force-register every group the instrumented modules use
+    for group in ("ec_encode", "ec_decode", "gf_matmul",
+                  "gf_bitmatrix", "crush"):
+        ks.record(group)
+    ks.counter("crush", "pgs")
+    return [build_osd_perf(0), build_mapping_perf(), ks.perf]
+
+
+def check_all(sets=None) -> list[str]:
+    sets = product_counter_sets() if sets is None else sets
+    errors: list[str] = []
+    cross: set[str] = set()
+    for pc in sets:
+        errors.extend(check_perf_counters(pc))
+        for name in pc._counters:
+            key = f"{pc.name}.{name}".replace(".", "_")
+            if key in cross:
+                errors.append(
+                    f"{pc.name}.{name}: collides with another set "
+                    "after exporter name-flattening"
+                )
+            cross.add(key)
+    return errors
+
+
+def main() -> int:
+    errors = check_all()
+    for err in errors:
+        print(f"check_metrics: {err}", file=sys.stderr)
+    if errors:
+        print(f"check_metrics: {len(errors)} error(s)", file=sys.stderr)
+        return 1
+    print("check_metrics: all counter schemas clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
