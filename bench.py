@@ -1015,6 +1015,16 @@ def main() -> None:
 
         traceback.print_exc()
         out["error"] = f"{type(e).__name__}: {e}"
+    try:
+        # kernel-behavior snapshot (compile-cache hit ratio, per-group
+        # call/byte totals) so BENCH_*.json trajectories capture HOW
+        # the kernels ran, not just the headline GB/s — emitted even
+        # when the measurement above crashed
+        from ceph_tpu.ops.kernel_stats import kernel_stats
+
+        out["kernel_stats"] = kernel_stats().snapshot()
+    except Exception:  # noqa: BLE001 — never lose the result line
+        pass
     print(json.dumps(out))
 
 

@@ -8,6 +8,7 @@ same two services for the TPU framework's daemons and tools.
 
 from .admin_socket import AdminSocket, admin_command
 from .config import Config, Option, OPT_INT, OPT_STR, OPT_BOOL, OPT_FLOAT
+from .log_client import LogChannel, LogClient
 from .op_tracker import OpTracker, TrackedOp
 from .perf_counters import (
     PerfCounters,
@@ -20,6 +21,8 @@ __all__ = [
     "AdminSocket",
     "admin_command",
     "Config",
+    "LogChannel",
+    "LogClient",
     "OpTracker",
     "Span",
     "TrackedOp",

@@ -17,7 +17,8 @@ from collections import deque
 
 DEFAULT_SUBSYS_LEVEL = 5
 
-# the subsystems built so far (subsys.h's table, trimmed)
+# subsys.h's table, covering every subsystem the daemons log under
+# (a name missing here would silently gate at the default level)
 SUBSYSTEMS = {
     "crush": 1,
     "ec": 1,
@@ -25,6 +26,16 @@ SUBSYSTEMS = {
     "store": 5,
     "config": 5,
     "balancer": 5,
+    "mon": 5,
+    "mgr": 5,
+    "msg": 1,
+    "mds": 5,
+    "rgw": 5,
+    "rbd": 5,
+    "client": 5,
+    # the cluster-log mirror (LogClient entries echo into the local
+    # dout ring so a crash dump shows what the daemon clogged)
+    "clog": 5,
 }
 
 
@@ -68,24 +79,26 @@ class Log:
         self.dout(subsys, 0, message)
 
     # -- crash dump --------------------------------------------------------
-    def dump_recent(self) -> list[dict]:
-        """The SIGSEGV-handler dump of the ring buffer."""
+    def dump_recent(self, subsys: str | None = None) -> list[dict]:
+        """The SIGSEGV-handler dump of the ring buffer, optionally
+        filtered to one subsystem."""
         with self._lock:
             return [
                 {
                     "stamp": stamp,
-                    "subsys": subsys,
+                    "subsys": s,
                     "level": level,
                     "message": message,
                 }
-                for stamp, subsys, level, message in self._recent
+                for stamp, s, level, message in self._recent
+                if subsys is None or s == subsys
             ]
 
     def register_admin_commands(self, admin_socket) -> None:
         admin_socket.register_command(
             "log dump",
-            lambda args: self.dump_recent(),
-            "dump recent log entries",
+            lambda args: self.dump_recent(args.get("subsys")),
+            "dump recent log entries (optional subsys filter)",
         )
 
         def _set(args):

@@ -55,7 +55,9 @@ import queue
 import threading
 import time
 
+from ..common import crash as crash_util
 from ..common import lockdep
+from ..common.log_client import LogClient
 from ..msg import Messenger
 from ..msg.message import (
     MClientCaps,
@@ -122,6 +124,12 @@ class MDSDaemon(Dispatcher):
 
         self._sessions: dict[Connection, _Session] = {}
         self._cap_holders: dict[int, set[_Session]] = {}
+
+        # cluster log + crash capture: entries drain to the mon on the
+        # beacon cadence; crash reports join the process-global queue
+        # the mgr crash module drains (no mgr session on the MDS)
+        self._log_client = LogClient(f"mds.{name}")
+        self.clog = self._log_client.channel()
 
         self.msgr = Messenger(f"mds.{name}")
         self.msgr.add_dispatcher(self)
@@ -216,6 +224,7 @@ class MDSDaemon(Dispatcher):
                         self.rados.objecter.new_identity()
             except Exception:  # noqa: BLE001 — beacons retry forever
                 pass
+            self._log_client.flush(self.rados.monc)
             self._stop.wait(self.beacon_interval)
 
     def _become_active(self, rank: int = 0) -> None:
@@ -247,6 +256,10 @@ class MDSDaemon(Dispatcher):
             self.replayed_entries = replayed
             self._load_next_ino()
             self.state = "active"
+            self.clog.info(
+                f"mds.{self.name} is now active for rank {rank} "
+                f"(replayed {replayed} journal entries)"
+            )
 
     def _apply_subtree_table(self, table: dict, te: int) -> None:
         """Subtree table changed (a pin moved authority): flush ALL
@@ -619,10 +632,14 @@ class MDSDaemon(Dispatcher):
                 return
             try:
                 self._process(*item)
-            except Exception:  # noqa: BLE001 — the worker survives
+            except Exception as e:  # noqa: BLE001 — the worker
+                # survives; the dead op files a crash report
                 import traceback
 
                 traceback.print_exc()
+                crash_util.capture(
+                    f"mds.{self.name}", e, clog=self.clog
+                )
 
     def _process(self, conn: Connection, msg: MClientRequest) -> None:
         reply = MClientReply(tid=msg.tid)

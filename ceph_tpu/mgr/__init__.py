@@ -38,10 +38,12 @@ import threading
 import time
 from collections import OrderedDict, deque
 
+from ..common import crash as crash_util
 from ..common import tracing
+from ..common.log_client import LogClient
 from ..mon.monitor import MonClient
 from ..msg import Messenger
-from ..msg.message import MMgrReport
+from ..msg.message import MMgrReport, MMonCommand, MMonCommandReply
 from ..msg.messenger import Dispatcher
 
 __all__ = ["Manager", "MgrModule"]
@@ -61,8 +63,8 @@ class MgrModule:
     def get(self, what: str):
         return self.mgr.get(what)
 
-    def mon_command(self, cmd: dict):
-        return self.mgr.monc.command(cmd)
+    def mon_command(self, cmd: dict, timeout: float = 15.0):
+        return self.mgr.monc.command(cmd, timeout=timeout)
 
     def get_module_option(self, key: str, default=None):
         return self.mgr.module_options.get(self.NAME, {}).get(
@@ -103,6 +105,7 @@ class Manager(Dispatcher):
                 TelemetryModule,
                 DashboardModule,
                 TracingModule,
+                CrashModule,
             ]
         )
         self.modules: dict[str, MgrModule] = {}
@@ -115,11 +118,35 @@ class Manager(Dispatcher):
         # drained by the tracing module's tick; bounded so a span
         # firehose with no tracing module cannot grow without limit
         self._span_inbox: deque[tuple[str, list]] = deque(maxlen=4096)
+        # crash inbox: reports piggybacked on MMgrReport, drained by
+        # the crash module's tick (bounded the same way)
+        self._crash_inbox: deque[dict] = deque(maxlen=256)
+        # the mgr's own cluster-log channel (flushed on the tick)
+        self._log_client = LogClient(f"mgr.{name}")
+        self.clog = self._log_client.channel()
         self.messenger.add_dispatcher(self)
         self.addr: str | None = None
 
     # -- MMgrReport ingestion (DaemonServer::handle_report) ----------------
     def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MMonCommand):
+            # mgr-targeted commands (`ceph crash ...`): the reference
+            # CLI routes MgrCommands to the active mgr the same way.
+            # Handled OFF the messenger loop — a handler that talks
+            # back to the mon (crash archive → "crash report") would
+            # deadlock the loop thread on its own blocking call
+            def run(msg=msg, conn=conn):
+                reply = self.handle_command(msg.cmd)
+                reply.tid = msg.tid
+                try:
+                    conn.send(reply)
+                except Exception:  # noqa: BLE001 — caller gone
+                    pass
+
+            threading.Thread(
+                target=run, name="mgr.command", daemon=True
+            ).start()
+            return True
         if not isinstance(msg, MMgrReport):
             return False
         try:
@@ -129,6 +156,14 @@ class Manager(Dispatcher):
         if spans:
             self._span_inbox.append((msg.daemon, spans))
         try:
+            crashes = json.loads(msg.crashes)
+        except ValueError:
+            crashes = []
+        if isinstance(crashes, list):
+            self._crash_inbox.extend(
+                c for c in crashes if isinstance(c, dict)
+            )
+        try:
             dump = json.loads(msg.perf)
         except ValueError:
             return True
@@ -136,6 +171,25 @@ class Manager(Dispatcher):
             with self._perf_lock:
                 self.daemon_perf[msg.daemon] = (time.time(), dump)
         return True
+
+    # -- mgr command surface (MgrCommands dispatch) ------------------------
+    def handle_command(self, cmd_json: str) -> MMonCommandReply:
+        """Route a command to the owning module (prefix word 1 names
+        it: "crash ls" → modules["crash"]); always reply."""
+        try:
+            cmd = json.loads(cmd_json)
+            prefix = cmd.get("prefix", "")
+            mod = self.modules.get(prefix.split(" ")[0])
+            handler = getattr(mod, "handle_command", None)
+            if handler is None:
+                return MMonCommandReply(
+                    rc=-22, outs=f"unknown mgr command {prefix!r}"
+                )
+            return handler(cmd)
+        except Exception as e:  # noqa: BLE001 — the RPC contract
+            return MMonCommandReply(
+                rc=-22, outs=f"{type(e).__name__}: {e}"
+            )
 
     def ms_handle_reset(self, conn) -> None:
         pass
@@ -194,11 +248,19 @@ class Manager(Dispatcher):
                 mod._last_tick = now
                 try:
                     mod.serve()
-                except Exception:  # noqa: BLE001 — a module must not
-                    # kill the host (mgr module crash containment)
+                except Exception as e:  # noqa: BLE001 — a module must
+                    # not kill the host (mgr module crash containment);
+                    # the contained crash still files a report
                     import traceback
 
                     traceback.print_exc()
+                    crash_util.capture(
+                        f"mgr.{self.name}",
+                        e,
+                        clog=self.clog,
+                        extra_meta={"module": mod.NAME},
+                    )
+            self._log_client.flush(self.monc)
 
     # -- cluster state snapshots (MgrModule.get) ---------------------------
     def get(self, what: str):
@@ -259,14 +321,44 @@ class Manager(Dispatcher):
 
 
 class StatusModule(MgrModule):
-    """Health rollup (the mgr status/health surface)."""
+    """Health rollup (the mgr status/health surface).  The tick polls
+    the mon's authoritative rollup (`health`, with mute-aware
+    checks_detail) and the cluster-log counters (`log stat`) so the
+    prometheus exporter and dashboard serve them without a mon
+    round-trip per scrape."""
 
     NAME = "status"
+    TICK_EVERY = 2.0  # two mon round-trips per tick: keep it off the
+    # hot path (scrapes read the cache)
+
+    def __init__(self, mgr: "Manager"):
+        super().__init__(mgr)
+        self.last_health: dict = {}
+        self.last_log_stat: dict = {}
+
+    def serve(self) -> None:
+        # SHORT timeout: these are cache refreshes on the shared mgr
+        # tick thread — during a mon outage the default 15s failover
+        # retry would stall every other module's tick
+        try:
+            reply = self.mon_command({"prefix": "health"}, timeout=2.0)
+            if reply.rc == 0 and reply.outb:
+                self.last_health = json.loads(reply.outb)
+            reply = self.mon_command(
+                {"prefix": "log stat"}, timeout=2.0
+            )
+            if reply.rc == 0 and reply.outb:
+                self.last_log_stat = json.loads(reply.outb)
+        except Exception:  # noqa: BLE001 — mon away: keep last known
+            pass
 
     def health(self) -> dict:
         stats = self.get("osd_stats")
         if stats is None:
             return {"status": "HEALTH_WARN", "checks": ["no map"]}
+        if self.last_health:
+            return {**self.last_health, **stats}
+        # no mon rollup yet: degrade to the local map view
         checks = []
         if stats["num_up"] < stats["num_in"]:
             checks.append(
@@ -401,12 +493,12 @@ class PrometheusModule(MgrModule):
         # under a different family's name
         headered: set[str] = set()
 
-        def metric(name, value, help_=None, labels=None):
+        def metric(name, value, help_=None, labels=None, kind="gauge"):
             name = self.sanitize_name(name)
             if help_ and name not in headered:
                 headered.add(name)
                 out.append(f"# HELP {name} {help_}")
-                out.append(f"# TYPE {name} gauge")
+                out.append(f"# TYPE {name} {kind}")
             lbl = ""
             if labels:
                 inner = ",".join(
@@ -463,6 +555,54 @@ class PrometheusModule(MgrModule):
                 entry["pg_num"],
                 "per-pool pg count",
                 labels={"pool": entry["name"]},
+            )
+        # -- event plane: health detail, crash reports, cluster log --------
+        status_mod = self.mgr.modules.get("status")
+        health = getattr(status_mod, "last_health", None) or {}
+        sev = {"HEALTH_OK": 0, "HEALTH_WARN": 1, "HEALTH_ERR": 2}
+        metric(
+            "ceph_health_status",
+            sev.get(health.get("status"), 0),
+            "cluster health (0=OK 1=WARN 2=ERR), mutes applied",
+        )
+        for code, det in sorted(
+            (health.get("checks_detail") or {}).items()
+        ):
+            metric(
+                "ceph_health_detail",
+                1,
+                "active health checks incl. muted ones",
+                labels={
+                    "name": code,
+                    "severity": det.get("severity", "HEALTH_WARN"),
+                    "muted": "true" if det.get("muted") else "false",
+                },
+            )
+        crash_mod = self.mgr.modules.get("crash")
+        if crash_mod is not None:
+            metric(
+                "ceph_crash_reports_total",
+                crash_mod.total_ingested,
+                "crash reports ingested by the mgr crash module",
+                kind="counter",  # *_total + monotonic: OpenMetrics
+                # parsers reject a gauge under this name
+            )
+            metric(
+                "ceph_crash_reports_recent",
+                len(crash_mod.recent()),
+                "un-archived recent crashes (the RECENT_CRASH count)",
+            )
+        log_stat = getattr(status_mod, "last_log_stat", None) or {}
+        for key, count in sorted(
+            (log_stat.get("by_channel_prio") or {}).items()
+        ):
+            channel, _, prio = key.partition("/")
+            metric(
+                "ceph_cluster_log_messages_total",
+                count,
+                "cluster log entries by channel and priority",
+                labels={"channel": channel, "prio": prio},
+                kind="counter",
             )
         return "\n".join(out) + "\n"
 
@@ -606,6 +746,24 @@ class DashboardModule(MgrModule):
             if isinstance(mod, TelemetryModule):
                 return mod.report()
             return {}
+        if what == "crashes":
+            mod = self.mgr.modules.get("crash")
+            if isinstance(mod, CrashModule):
+                mod.ingest_pending()
+                return mod.ls()
+            return []
+        if what == "log":
+            try:
+                # short timeout: this runs per HTTP request — a dead
+                # mon must not hang page loads for the 15s failover
+                reply = self.mgr.monc.command(
+                    {"prefix": "log last", "num": 20}, timeout=2.0
+                )
+                if reply.rc == 0 and reply.outb:
+                    return json.loads(reply.outb)
+            except Exception:  # noqa: BLE001 — mon away
+                pass
+            return []
         raise KeyError(what)
 
     def render_html(self) -> str:
@@ -625,15 +783,44 @@ class DashboardModule(MgrModule):
             f"<td>{p['size']}</td></tr>"
             for p in pools
         )
+        import html as _html
+
+        crashes = self.api("crashes")
+        recent_log = self.api("log")
+        # clog messages are remotely-injectable free text (`ceph log
+        # <anything>`): escape EVERY field or the dashboard is stored
+        # XSS for whoever can reach the mon
+        lrows = "".join(
+            "<tr>"
+            + "".join(
+                f"<td>{_html.escape(str(e.get(k, '')))}</td>"
+                for k in ("name", "channel", "prio", "message")
+            )
+            + "</tr>"
+            for e in recent_log[-10:]
+        )
+        muted = _html.escape(
+            ", ".join(health.get("muted", [])) or "none"
+        )
+        # health summaries carry wire-injectable text too (SLOW_OPS
+        # embeds reporter daemon names): escape like the log rows
+        status = _html.escape(str(health.get("status", "?")))
+        checks = _html.escape(
+            ", ".join(health.get("checks", [])) or "no checks"
+        )
         return (
             "<html><head><title>ceph-tpu</title></head><body>"
-            f"<h1>cluster: {health.get('status', '?')}</h1>"
-            f"<p>{', '.join(health.get('checks', [])) or 'no checks'}"
-            "</p><h2>osds</h2><table border=1><tr><th>osd</th>"
+            f"<h1>cluster: {status}</h1>"
+            f"<p>{checks}"
+            f"</p><p>muted checks: {muted} &middot; crash reports: "
+            f"{len(crashes)}</p>"
+            "<h2>osds</h2><table border=1><tr><th>osd</th>"
             f"<th>state</th><th>in/out</th><th>addr</th></tr>{rows}"
             "</table><h2>pools</h2><table border=1><tr><th>name</th>"
             f"<th>pg_num</th><th>type</th><th>size</th></tr>{prows}"
-            "</table></body></html>"
+            "</table><h2>cluster log</h2><table border=1>"
+            "<tr><th>from</th><th>channel</th><th>prio</th>"
+            f"<th>message</th></tr>{lrows}</table></body></html>"
         )
 
 
@@ -734,6 +921,204 @@ class TracingModule(MgrModule):
                     for tid, e in self._traces.items()
                 },
             }
+
+
+class CrashModule(MgrModule):
+    """Crash-report collection (src/pybind/mgr/crash reduced): drains
+    reports piggybacked on MMgrReport plus the process-global pending
+    queue (co-hosted daemons), dedupes by crash_id, serves
+    ``ceph crash ls / info <id> / stat / archive [<id>|all]``, and
+    keeps the mon's RECENT_CRASH count current via the "crash report"
+    command — archiving pushes the cleared count, which clears the
+    health warning."""
+
+    NAME = "crash"
+    TICK_EVERY = 0.5
+    # un-archived crashes younger than this raise RECENT_CRASH
+    # (mgr/crash/warn_recent_interval; the reference defaults to two
+    # weeks)
+    DEFAULT_WARN_RECENT_INTERVAL = 14 * 24 * 3600.0
+
+    def __init__(self, mgr: "Manager"):
+        super().__init__(mgr)
+        self.max_reports = int(self.get_module_option("max_reports", 128))
+        self.crashes: "OrderedDict[str, dict]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.total_ingested = 0
+        self._last_reported: int | None = None
+        self._last_report_time = 0.0
+
+    def serve(self) -> None:
+        self.ingest_pending()
+        self._report_health()
+
+    # -- ingest ------------------------------------------------------------
+    def ingest_pending(self) -> None:
+        """Drain both delivery paths (callable directly so tests need
+        not wait a tick)."""
+        while True:
+            try:
+                report = self.mgr._crash_inbox.popleft()
+            except IndexError:
+                break
+            self._ingest(report)
+        for report in crash_util.drain_pending():
+            self._ingest(report)
+
+    def _ingest(self, report: dict) -> None:
+        cid = report.get("crash_id")
+        if not cid or not isinstance(cid, str):
+            return
+        with self._lock:
+            if cid in self.crashes:
+                return  # double delivery (wire + global queue)
+            report.setdefault("archived", False)
+            self.crashes[cid] = report
+            self.total_ingested += 1
+            while len(self.crashes) > self.max_reports:
+                self.crashes.popitem(last=False)
+
+    # -- health ------------------------------------------------------------
+    def _is_recent(self, report: dict, cutoff: float) -> bool:
+        """The ONE recency predicate (health count and `crash stat`
+        must never disagree)."""
+        return (
+            not report.get("archived")
+            and float(report.get("timestamp", 0)) >= cutoff
+        )
+
+    def _recent_cutoff(self) -> float:
+        interval = float(
+            self.get_module_option(
+                "warn_recent_interval",
+                self.DEFAULT_WARN_RECENT_INTERVAL,
+            )
+        )
+        return time.time() - interval
+
+    def recent(self) -> list[dict]:
+        cutoff = self._recent_cutoff()
+        with self._lock:
+            return [
+                r
+                for r in self.crashes.values()
+                if self._is_recent(r, cutoff)
+            ]
+
+    def _report_health(self) -> None:
+        n = len(self.recent())
+        now = time.monotonic()
+        # re-push an UNCHANGED count every few seconds anyway: the
+        # mon holds it in memory only, so a restarted mon would
+        # otherwise show HEALTH_OK over un-archived crashes forever
+        # (the SLOW_OPS re-report idiom)
+        if n == self._last_reported and now - self._last_report_time < 5.0:
+            return
+        try:
+            reply = self.mon_command(
+                {"prefix": "crash report", "num_recent": n},
+                timeout=2.0,  # tick thread: never stall other modules
+            )
+            if reply.rc == 0:
+                self._last_reported = n
+                self._last_report_time = now
+        except Exception:  # noqa: BLE001 — retried next tick
+            pass
+
+    # -- query/command surface ---------------------------------------------
+    def ls(self) -> list[dict]:
+        with self._lock:
+            return sorted(
+                (
+                    {
+                        "crash_id": r["crash_id"],
+                        "entity_name": r.get("entity_name", ""),
+                        "timestamp_iso": r.get("timestamp_iso", ""),
+                        "exception": r.get("exception", ""),
+                        "archived": bool(r.get("archived")),
+                    }
+                    for r in self.crashes.values()
+                ),
+                key=lambda r: r["crash_id"],
+            )
+
+    def info(self, crash_id: str) -> dict | None:
+        with self._lock:
+            return self.crashes.get(crash_id)
+
+    def stat(self) -> dict:
+        cutoff = self._recent_cutoff()
+        with self._lock:
+            archived = sum(
+                1 for r in self.crashes.values() if r.get("archived")
+            )
+            return {
+                "total_ingested": self.total_ingested,
+                "held": len(self.crashes),
+                "archived": archived,
+                "recent": sum(
+                    1
+                    for r in self.crashes.values()
+                    if self._is_recent(r, cutoff)
+                ),
+            }
+
+    def archive(self, crash_id: str) -> bool:
+        with self._lock:
+            report = self.crashes.get(crash_id)
+            if report is None:
+                return False
+            report["archived"] = True
+        self._report_health()
+        return True
+
+    def archive_all(self) -> int:
+        with self._lock:
+            n = 0
+            for r in self.crashes.values():
+                if not r.get("archived"):
+                    r["archived"] = True
+                    n += 1
+        self._report_health()
+        return n
+
+    def handle_command(self, cmd: dict) -> MMonCommandReply:
+        prefix = cmd.get("prefix", "")
+        self.ingest_pending()  # a just-crashed daemon shows up now
+        if prefix == "crash ls":
+            rows = self.ls()
+            return MMonCommandReply(
+                outs="\n".join(
+                    f"{r['crash_id']}  {r['entity_name']}"
+                    + ("  (archived)" if r["archived"] else "")
+                    for r in rows
+                ),
+                outb=json.dumps(rows),
+            )
+        if prefix == "crash info":
+            report = self.info(str(cmd.get("id", "")))
+            if report is None:
+                return MMonCommandReply(
+                    rc=-2, outs="no such crash (-ENOENT)"
+                )
+            return MMonCommandReply(outb=json.dumps(report))
+        if prefix == "crash stat":
+            return MMonCommandReply(outb=json.dumps(self.stat()))
+        if prefix == "crash archive":
+            target = str(cmd.get("id", ""))
+            if target == "all":
+                n = self.archive_all()
+                return MMonCommandReply(
+                    outs=f"archived {n} crash report(s)"
+                )
+            if not self.archive(target):
+                return MMonCommandReply(
+                    rc=-2, outs="no such crash (-ENOENT)"
+                )
+            return MMonCommandReply(outs=f"archived {target}")
+        return MMonCommandReply(
+            rc=-22, outs=f"unknown crash command {prefix!r}"
+        )
 
 
 class PgAutoscalerModule(MgrModule):

@@ -20,10 +20,19 @@ callback (the OSD's handle_osd_map role).
 from __future__ import annotations
 
 import json
+import re as _re
 import threading
 import time
+from collections import deque
 
+from ..common.log_client import (
+    CLOG_PRIOS as _clog_prios,
+    MAX_CHANNEL_LEN as _MAX_CHANNEL_LEN,
+    MAX_MESSAGE_LEN as _MAX_MESSAGE_LEN,
+    MAX_NAME_LEN as _MAX_NAME_LEN,
+)
 from ..msg import (
+    MLog,
     MOSDMap,
     Message,
     MessageError,
@@ -43,6 +52,20 @@ from ..osd.osdmap import Incremental, OSDMap, PgPool
 from ..store.objectstore import MemStore, ObjectStore, StoreError, Transaction
 
 MON_COLL = "mon_store"
+
+# cluster-log vocabulary accepted off the wire: the prio ladder is
+# OWNED by common/log_client.py (one source — a prio added there must
+# not be clamped away here); LogStore.add rewrites anything else.
+# The channel rule excludes '/' so the "channel/prio" totals key
+# stays unambiguous.
+_CLOG_PRIOS = frozenset(_clog_prios)
+_CHANNEL_RE = _re.compile(r"^[a-zA-Z][a-zA-Z0-9_.-]{0,63}$")
+
+# health-mute bounds: mute codes are client-supplied strings stored
+# until unmute/expiry — cap count and length or a loop of unique
+# no-TTL mutes grows the mon without bound
+MAX_HEALTH_MUTES = 64
+MAX_MUTE_CODE_LEN = 64
 
 
 class MonitorStore:
@@ -91,6 +114,152 @@ class MonitorStore:
         except StoreError:
             return None
 
+    # -- generic blobs (the non-osdmap PaxosService keys: clog, ...) --------
+    def put_blob(self, key: str, blob: bytes) -> None:
+        txn = Transaction()
+        txn.touch(MON_COLL, key)
+        # truncate first: a shorter rewrite must not leave the old
+        # tail glued onto the new blob
+        txn.truncate(MON_COLL, key, 0)
+        txn.write(MON_COLL, key, 0, blob)
+        self.store.queue_transaction(txn)
+
+    def get_blob(self, key: str) -> bytes | None:
+        try:
+            return self.store.read(MON_COLL, key)
+        except StoreError:
+            return None
+
+
+class LogStore:
+    """The LogMonitor role (src/mon/LogMonitor.{h,cc} reduced):
+    cluster-log entries from MLog batches land in a bounded window
+    with per-(channel, prio) running totals, persisted as one blob in
+    the MonitorStore so a restarted mon keeps its health timeline.
+    ``last`` serves ``ceph log last [n] [level] [channel]``."""
+
+    KEY = "clog"
+    MAX_TOTALS_KEYS = 64  # counter-cardinality bound (see add())
+
+    def __init__(self, store: MonitorStore, max_entries: int = 500):
+        self.store = store
+        self.max_entries = max_entries
+        self._entries: deque[dict] = deque(maxlen=max_entries)
+        self._totals: dict[str, int] = {}  # "channel/prio" -> count
+        self.total = 0
+        # persistence is THROTTLED (the reference batches LogMonitor
+        # commits through paxos the same way): the in-memory window is
+        # authoritative for `log last`; a mon restart may lose the
+        # last ~1s of entries
+        self._last_persist = 0.0
+        blob = store.get_blob(self.KEY)
+        if blob:
+            try:
+                state = json.loads(blob)
+                self._entries.extend(state.get("entries", []))
+                self._totals = dict(state.get("totals", {}))
+                self.total = int(state.get("total", 0))
+            except (ValueError, TypeError):
+                pass  # corrupt window: start fresh, never crash the mon
+
+    def add(self, entries: list[dict]) -> int:
+        added = 0
+        for raw in entries:
+            if not isinstance(raw, dict) or "message" not in raw:
+                continue
+            # coerce EVERY field: entries arrive off the wire, and a
+            # wrong-typed prio/stamp persisted into the window would
+            # break `log last` until it ages out
+            try:
+                entry = {
+                    "name": str(raw.get("name", "unknown"))[
+                        :_MAX_NAME_LEN
+                    ],
+                    "stamp": float(raw.get("stamp", time.time())),
+                    "channel": str(raw.get("channel", "cluster"))[
+                        :_MAX_CHANNEL_LEN
+                    ],
+                    "prio": str(raw.get("prio", "info")),
+                    "message": str(raw["message"])[
+                        :_MAX_MESSAGE_LEN
+                    ],
+                    "seq": int(raw.get("seq", 0)),
+                }
+            except (TypeError, ValueError):
+                continue  # unsalvageable entry: drop, never poison
+            # channel and prio become _totals keys, prometheus label
+            # values, and persisted state: clamp to a safe vocabulary
+            # or an attacker looping `ceph log` with unique channels
+            # grows mon memory and scrape size without bound (and a
+            # '/' in a channel would corrupt the "channel/prio" key)
+            if entry["prio"] not in _CLOG_PRIOS:
+                entry["prio"] = "info"
+            if not _CHANNEL_RE.match(entry["channel"]):
+                entry["channel"] = "cluster"
+            self._entries.append(entry)
+            key = f"{entry['channel']}/{entry['prio']}"
+            if (
+                key not in self._totals
+                and len(self._totals) >= self.MAX_TOTALS_KEYS
+            ):
+                # bounded counter cardinality: overflow channels fold
+                # into one bucket instead of growing forever
+                key = f"other/{entry['prio']}"
+            self._totals[key] = self._totals.get(key, 0) + 1
+            self.total += 1
+            added += 1
+        now = time.time()
+        if added and now - self._last_persist >= 1.0:
+            self._last_persist = now
+            self._persist()
+        return added
+
+    def last(
+        self,
+        n: int = 20,
+        level: str | None = None,
+        channel: str | None = None,
+    ) -> list[dict]:
+        from ..common.log_client import prio_rank
+
+        if int(n) <= 0:
+            return []
+        entries = list(self._entries)
+        if channel:
+            entries = [
+                e for e in entries if e.get("channel") == channel
+            ]
+        if level:
+            floor = prio_rank(level)
+            entries = [
+                e
+                for e in entries
+                if prio_rank(e.get("prio", "info")) >= floor
+            ]
+        return entries[-max(0, int(n)):]
+
+    def stat(self) -> dict:
+        return {
+            "total": self.total,
+            "window": len(self._entries),
+            "by_channel_prio": dict(self._totals),
+        }
+
+    def _persist(self) -> None:
+        try:
+            self.store.put_blob(
+                self.KEY,
+                json.dumps(
+                    {
+                        "entries": list(self._entries),
+                        "totals": self._totals,
+                        "total": self.total,
+                    }
+                ).encode(),
+            )
+        except StoreError:
+            pass  # the in-memory window still serves `log last`
+
 
 class Monitor(Dispatcher):
     """Single-node map authority (Monitor + OSDMonitor roles)."""
@@ -126,6 +295,18 @@ class Monitor(Dispatcher):
         # in-memory per monitor, like mgr beacons — a count of 0
         # clears; stale reports age out of health after the grace
         self.slow_ops: dict[str, tuple[float, int, float]] = {}
+        # cluster log (LogMonitor role): MLog batches + the mon's own
+        # entries land here and serve `ceph log last`
+        self.clog_store = LogStore(self.store)
+        # health mutes (HealthMonitor mutes): code -> expiry wallclock
+        # (inf = no TTL); muted codes leave the rollup, not the detail
+        self.health_mutes: dict[str, float] = {}
+        # un-archived recent crash count, pushed by the mgr crash
+        # module ("crash report") — raises RECENT_CRASH
+        self.recent_crashes = 0
+        # last health-check code set, so transitions (raise/clear)
+        # write the cluster log — the health timeline
+        self._prev_health: set[str] = set()
 
     def slow_op_report_grace(self) -> float:
         """mon_slow_op_report_grace: the centralized config database
@@ -165,6 +346,96 @@ class Monitor(Dispatcher):
             inc = self.pending()
             inc.mark_down(target)
             self.commit(inc)
+            self._clog(
+                "warn",
+                f"osd.{target} marked down after failure reports",
+            )
+
+    # -- cluster log (LogMonitor ingest + the mon's own channel) -----------
+    def _clog(
+        self, prio: str, message: str, channel: str = "cluster"
+    ) -> None:
+        """The mon's own cluster-log entry (no wire hop needed)."""
+        self.clog_store.add(
+            [
+                {
+                    "name": "mon.0",
+                    "stamp": time.time(),
+                    "channel": channel,
+                    "prio": prio,
+                    "message": message,
+                    "seq": self.clog_store.total + 1,
+                }
+            ]
+        )
+
+    # -- health (HealthMonitor role) ---------------------------------------
+    def health_checks(self) -> dict[str, dict]:
+        """Every active health check, code -> {severity, summary} —
+        BEFORE mutes.  State transitions against the previous
+        evaluation are clogged, so the cluster log is the health
+        timeline (LogMonitor's health-to-clog path)."""
+        m = self.osdmap
+        checks: dict[str, dict] = {}
+        down = [
+            o for o in range(m.max_osd)
+            if m.exists(o) and not m.is_up(o)
+        ]
+        out = [
+            o for o in range(m.max_osd)
+            if m.exists(o) and m.osd_weight[o] == 0
+        ]
+        if down:
+            checks["OSD_DOWN"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(down)} osds down",
+            }
+        if out:
+            checks["OSD_OUT"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(out)} osds out",
+            }
+        # SLOW_OPS: fresh nonzero reports only — a crashed daemon's
+        # last report must not pin WARN forever
+        now = time.time()
+        grace = self.slow_op_report_grace()
+        slow_total, oldest, reporters = 0, 0.0, []
+        for daemon, (ts, count, age) in list(self.slow_ops.items()):
+            if now - ts > grace:
+                del self.slow_ops[daemon]
+                continue
+            if count > 0:
+                slow_total += count
+                oldest = max(oldest, age)
+                reporters.append(daemon)
+        if slow_total:
+            checks["SLOW_OPS"] = {
+                "severity": "HEALTH_WARN",
+                "summary": (
+                    f"{slow_total} slow ops, oldest one blocked for "
+                    f"{oldest:.0f} sec, daemons {sorted(reporters)} "
+                    "have slow ops (SLOW_OPS)"
+                ),
+            }
+        if self.recent_crashes:
+            checks["RECENT_CRASH"] = {
+                "severity": "HEALTH_WARN",
+                "summary": (
+                    f"{self.recent_crashes} daemons have recently "
+                    "crashed"
+                ),
+            }
+        cur = set(checks)
+        for code in sorted(cur - self._prev_health):
+            self._clog(
+                "warn",
+                f"Health check failed: "
+                f"{checks[code]['summary']} ({code})",
+            )
+        for code in sorted(self._prev_health - cur):
+            self._clog("info", f"Health check cleared: {code}")
+        self._prev_health = cur
+        return checks
 
     # -- subscriber fan-out ------------------------------------------------
     def _map_message(self, since: int) -> MOSDMap:
@@ -214,6 +485,18 @@ class Monitor(Dispatcher):
                 inc.mark_up(msg.osd, addr=msg.addr)
                 inc.mark_in(msg.osd)
                 self.commit(inc)
+                self._clog("info", f"osd.{msg.osd} boot")
+            return True
+        if isinstance(msg, MLog):
+            try:
+                entries = json.loads(msg.entries)
+            except ValueError:
+                entries = []
+            if isinstance(entries, list):
+                with self._lock:
+                    self.clog_store.add(
+                        [e for e in entries if isinstance(e, dict)]
+                    )
             return True
         if isinstance(msg, MMonCommand):
             reply = self.handle_command(msg.cmd)
@@ -226,6 +509,21 @@ class Monitor(Dispatcher):
         self._subs.pop(conn, None)
 
     # -- command surface (MonCommands.h role) ------------------------------
+    # read-only or high-rate periodic chatter: never audit-logged
+    # (the reference's `mon debug` vs audit-channel split)
+    _AUDIT_EXEMPT = frozenset(
+        {
+            "status", "health", "osd dump", "osd tree", "pg dump",
+            "osd pool ls", "config get", "config dump", "mgr stat",
+            "mds stat", "osd erasure-code-profile get",
+            "osd erasure-code-profile ls",
+            "log last", "log stat",
+            # periodic daemon chatter
+            "mds beacon", "mgr beacon", "osd slow ops",
+            "crash report",
+        }
+    )
+
     def handle_command(self, cmd_json: str) -> MMonCommandReply:
         try:
             cmd = json.loads(cmd_json)
@@ -236,10 +534,32 @@ class Monitor(Dispatcher):
                     rc=-22, outs=f"unknown command {prefix!r}"
                 )
             with self._lock:
+                if prefix not in self._AUDIT_EXEMPT:
+                    # mutating operator commands hit the audit channel
+                    # (the reference logs every dispatch to clog audit)
+                    self._clog(
+                        "info",
+                        f"cmd={cmd_json[:512]}: dispatch",
+                        channel="audit",
+                    )
                 return handler(self, cmd)
         except Exception as e:  # noqa: BLE001 — the RPC contract: a
             # command must ALWAYS produce a reply (a raised handler
             # would otherwise leave the caller blocked to timeout)
+            if not isinstance(
+                e, (KeyError, ValueError, TypeError, AttributeError)
+            ):
+                # those four are malformed-input shapes (missing,
+                # bad, or wrong-typed fields — e.g. cmd='[]' makes
+                # .get raise AttributeError) — operator error, not a
+                # mon crash; filing reports for them would let any
+                # client raise RECENT_CRASH with garbage commands.
+                # Anything else is a real handler bug: file a report
+                from ..common import crash as _crash
+
+                _crash.capture(
+                    "mon.0", e, extra_meta={"cmd": cmd_json[:512]}
+                )
             return MMonCommandReply(rc=-22, outs=f"{type(e).__name__}: {e}")
 
 
@@ -561,45 +881,133 @@ def _cmd_osd_dump(mon: Monitor, cmd: dict) -> MMonCommandReply:
     )
 
 
-def _cmd_health(mon: Monitor, cmd: dict) -> MMonCommandReply:
-    """'ceph health' (HealthMonitor role): DOWN/OUT osds and fresh
-    SLOW_OPS reports degrade to WARN."""
-    m = mon.osdmap
-    down = [o for o in range(m.max_osd) if m.exists(o) and not m.is_up(o)]
-    out = [
-        o for o in range(m.max_osd)
-        if m.exists(o) and m.osd_weight[o] == 0
-    ]
-    checks = []
-    if down:
-        checks.append(f"{len(down)} osds down")
-    if out:
-        checks.append(f"{len(out)} osds out")
-    # SLOW_OPS (the reference's "N slow ops, oldest one blocked for
-    # Ns" health check): fresh nonzero reports only — a crashed
-    # daemon's last report must not pin WARN forever
+def _prune_mutes(mon: Monitor) -> None:
+    """TTL expiry: a lapsed mute restores the check to the rollup."""
     now = time.time()
-    grace = mon.slow_op_report_grace()
-    slow_total, oldest, reporters = 0, 0.0, []
-    for daemon, (ts, count, age) in list(mon.slow_ops.items()):
-        if now - ts > grace:
-            del mon.slow_ops[daemon]
-            continue
-        if count > 0:
-            slow_total += count
-            oldest = max(oldest, age)
-            reporters.append(daemon)
-    if slow_total:
-        checks.append(
-            f"{slow_total} slow ops, oldest one blocked for "
-            f"{oldest:.0f} sec, daemons {sorted(reporters)} have "
-            "slow ops (SLOW_OPS)"
-        )
-    status = "HEALTH_OK" if not checks else "HEALTH_WARN"
+    for code, expiry in list(mon.health_mutes.items()):
+        if expiry <= now:
+            del mon.health_mutes[code]
+            mon._clog("info", f"Health check unmuted: {code} (TTL)")
+
+
+def _cmd_health(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """'ceph health' (HealthMonitor role): DOWN/OUT osds, fresh
+    SLOW_OPS reports, and RECENT_CRASH degrade to WARN.  Muted codes
+    leave the rollup (status + checks) but stay in checks_detail —
+    mutes filter, they never lose detail."""
+    checks = mon.health_checks()
+    _prune_mutes(mon)
+    muted = {c for c in checks if c in mon.health_mutes}
+    active = {c: v for c, v in checks.items() if c not in muted}
+    status = "HEALTH_OK" if not active else "HEALTH_WARN"
     return MMonCommandReply(
         outs=status,
-        outb=json.dumps({"status": status, "checks": checks}),
+        outb=json.dumps(
+            {
+                "status": status,
+                "checks": [v["summary"] for v in active.values()],
+                "checks_detail": {
+                    code: {**v, "muted": code in muted}
+                    for code, v in checks.items()
+                },
+                "muted": sorted(muted),
+            }
+        ),
     )
+
+
+def _cmd_health_mute(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """'ceph health mute <code> [--ttl N]': drop a check code from
+    the health rollup (HealthMonitor mutes)."""
+    code = str(cmd.get("code", "")).strip()
+    if not code or len(code) > MAX_MUTE_CODE_LEN:
+        return MMonCommandReply(
+            rc=-22, outs="missing or oversized code (-EINVAL)"
+        )
+    if (
+        code not in mon.health_mutes
+        and len(mon.health_mutes) >= MAX_HEALTH_MUTES
+    ):
+        return MMonCommandReply(
+            rc=-7, outs="too many muted codes (-E2BIG)"
+        )
+    ttl = cmd.get("ttl")
+    expiry = float("inf") if ttl is None else time.time() + float(ttl)
+    mon.health_mutes[code] = expiry
+    mon._clog(
+        "info",
+        f"Health check muted: {code}"
+        + (f" (TTL {float(ttl):.0f}s)" if ttl is not None else ""),
+        channel="audit",
+    )
+    return MMonCommandReply(
+        outs=f"muted {code}",
+        outb=json.dumps({"code": code, "ttl": ttl}),
+    )
+
+
+def _cmd_health_unmute(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    code = str(cmd.get("code", "")).strip()
+    if code not in mon.health_mutes:
+        return MMonCommandReply(
+            rc=-2, outs=f"{code!r} is not muted (-ENOENT)"
+        )
+    del mon.health_mutes[code]
+    mon._clog(
+        "info", f"Health check unmuted: {code}", channel="audit"
+    )
+    return MMonCommandReply(outs=f"unmuted {code}")
+
+
+def _cmd_crash_report(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """mgr crash module → mon: the current count of un-archived
+    recent crashes (the mgr-raised health check surface).  Archiving
+    pushes 0, which clears RECENT_CRASH."""
+    mon.recent_crashes = max(0, int(cmd.get("num_recent", 0)))
+    return MMonCommandReply(outb=json.dumps({"ok": True}))
+
+
+def _cmd_log_last(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """'ceph log last [n] [level] [channel]' (LogMonitor's command)."""
+    n = int(cmd.get("num", 20))
+    level = cmd.get("level")
+    channel = cmd.get("channel")
+    entries = mon.clog_store.last(n, level=level, channel=channel)
+    return MMonCommandReply(
+        outs="\n".join(
+            f"{e['stamp']:.6f} {e['name']} ({e['channel']}) "
+            f"[{e['prio'].upper()}] {e['message']}"
+            for e in entries
+        ),
+        outb=json.dumps(entries),
+    )
+
+
+def _cmd_log_stat(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    return MMonCommandReply(outb=json.dumps(mon.clog_store.stat()))
+
+
+def _cmd_log_inject(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """'ceph log <text>': operator entry onto the cluster log (the
+    reference's `ceph log` command)."""
+    text = cmd.get("logtext", "")
+    if isinstance(text, list):
+        text = " ".join(str(t) for t in text)
+    if not text:
+        return MMonCommandReply(rc=-22, outs="missing logtext (-EINVAL)")
+    mon.clog_store.add(
+        [
+            {
+                "name": str(cmd.get("name", "client.admin")),
+                "stamp": time.time(),
+                "channel": str(cmd.get("channel", "cluster")),
+                "prio": str(cmd.get("prio", "info")),
+                "message": str(text),
+                "seq": 0,
+            }
+        ]
+    )
+    return MMonCommandReply(outs="logged")
 
 
 def _cmd_osd_slow_ops(mon: Monitor, cmd: dict) -> MMonCommandReply:
@@ -1211,6 +1619,12 @@ _COMMANDS = {
     "osd pool ls": _cmd_pool_ls,
     "pg dump": _cmd_pg_dump,
     "health": _cmd_health,
+    "health mute": _cmd_health_mute,
+    "health unmute": _cmd_health_unmute,
+    "crash report": _cmd_crash_report,
+    "log last": _cmd_log_last,
+    "log stat": _cmd_log_stat,
+    "log": _cmd_log_inject,
     "osd slow ops": _cmd_osd_slow_ops,
     "config set": _cmd_config_set,
     "config get": _cmd_config_get,
@@ -1319,7 +1733,15 @@ class MonClient(Dispatcher):
         while True:
             try:
                 self.ensure_connected()
-                reply = self._conn.call(MMonCommand(cmd=payload))
+                # bound the in-flight call by the caller's deadline
+                # too: a mon that accepts TCP but never replies must
+                # not hold a timeout=2.0 caller for the default 30s
+                reply = self._conn.call(
+                    MMonCommand(cmd=payload),
+                    timeout=max(
+                        0.5, min(30.0, deadline - time.monotonic())
+                    ),
+                )
                 assert isinstance(reply, MMonCommandReply)
                 if reply.rc == -11 and "-EAGAIN" in reply.outs:
                     # electing: wait and resend
@@ -1346,6 +1768,20 @@ class MonClient(Dispatcher):
                 reporter=self.whoami,
                 failed_for=failed_for,
                 epoch=self.epoch,
+            )
+        )
+
+    def send_log(self, entries: list[dict], name: str = "") -> None:
+        """Ship a drained LogClient batch to the mon (MLog); raises
+        MessageError/OSError on failure so the caller can requeue."""
+        if not entries:
+            return
+        self.ensure_connected()
+        self._conn.send(
+            MLog(
+                tid=self.messenger.new_tid(),
+                name=name or (entries[0].get("name", "") if entries else ""),
+                entries=json.dumps(entries),
             )
         )
 

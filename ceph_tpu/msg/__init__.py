@@ -24,6 +24,7 @@ AsyncMessenger plays beneath the OSDs.
 
 from .message import (
     MECSubRead,
+    MLog,
     MMonElection,
     MMonPaxos,
     MECSubReadReply,
@@ -55,6 +56,7 @@ __all__ = [
     "Connection",
     "Dispatcher",
     "MECSubRead",
+    "MLog",
     "MECSubReadReply",
     "MECSubWrite",
     "MECSubWriteReply",

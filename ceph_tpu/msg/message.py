@@ -989,18 +989,47 @@ class MMgrReport(Message):
     ``spans`` piggybacks the daemon's drained trace spans (a JSON
     list, common/tracing.py shape) on the same report — the mgr
     ``tracing`` module ingests them, so distributed tracing rides the
-    existing stats plane instead of needing its own session."""
+    existing stats plane instead of needing its own session.
+
+    ``crashes`` piggybacks pending crash reports (a JSON list,
+    common/crash.py shape) the same way — the mgr ``crash`` module
+    ingests them and raises RECENT_CRASH."""
 
     TYPE = 43
     daemon: str = ""
     perf: str = "{}"
     spans: str = "[]"
+    crashes: str = "[]"
 
     def encode_payload(self, e: Encoder) -> None:
         e.string(self.daemon).string(self.perf).string(self.spans)
+        e.string(self.crashes)
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MMgrReport":
         return cls(
-            daemon=d.string(), perf=d.string(), spans=d.string()
+            daemon=d.string(), perf=d.string(), spans=d.string(),
+            # versioned-decode tolerance: frames from before the
+            # crash plane carry no 4th string
+            crashes=d.string() if d.remaining() else "[]",
         )
+
+
+@register_message
+@dataclass
+class MLog(Message):
+    """Daemon → mon cluster-log batch (src/messages/MLog.h): the
+    LogClient's drained entries (common/log_client.py shape, a JSON
+    list) bound for the monitor's LogMonitor store, where they become
+    ``ceph log last``."""
+
+    TYPE = 45
+    name: str = ""  # sending daemon identity
+    entries: str = "[]"
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.name).string(self.entries)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MLog":
+        return cls(name=d.string(), entries=d.string())

@@ -135,6 +135,37 @@ class KernelStats:
     def dump(self) -> dict:
         return self.perf.dump()
 
+    def snapshot(self) -> dict:
+        """Compact rollup for result artifacts (bench.py embeds this
+        in the BENCH JSON line): compile-cache hit ratio plus per-group
+        call/byte totals — kernel behavior, not just GB/s."""
+        dump = self.dump()
+        hits = int(dump.get("l_tpu_compile_cache_hit", 0))
+        misses = int(dump.get("l_tpu_compile_cache_miss", 0))
+        lookups = hits + misses
+        groups = {}
+        with self._lock:
+            known = sorted(self._groups)
+        for group in known:
+            base = f"l_tpu_{group}"
+            lat = dump.get(f"{base}_lat") or {}
+            groups[group] = {
+                "calls": int(dump.get(f"{base}_calls", 0)),
+                "bytes_in": int(dump.get(f"{base}_bytes_in", 0)),
+                "bytes_out": int(dump.get(f"{base}_bytes_out", 0)),
+                "lat_sum_s": round(float(lat.get("sum", 0.0)), 6),
+            }
+        return {
+            "compile_cache": {
+                "hits": hits,
+                "misses": misses,
+                "hit_ratio": (
+                    round(hits / lookups, 4) if lookups else None
+                ),
+            },
+            "groups": groups,
+        }
+
 
 class _KernelTimer:
     __slots__ = ("_ks", "_group", "_bytes_in", "bytes_out", "_t0")

@@ -57,6 +57,7 @@ import json
 import threading
 import time
 import types
+from collections import deque
 
 from ..common.encoding import Decoder, Encoder
 from ..crush.types import CRUSH_ITEM_NONE
@@ -118,7 +119,9 @@ from ..msg.message import (
 )
 from ..msg.messenger import Connection, Dispatcher
 from ..cls import RD as CLS_RD, WR as CLS_WR, ClassError, MethodContext, default_handler
+from ..common import crash as crash_util
 from ..common.log import dout
+from ..common.log_client import LogClient
 from ..common import lockdep
 from ..mon.monitor import MonClient
 from ..native import ceph_crc32c
@@ -329,7 +332,7 @@ class OSD(Dispatcher):
             self.config.parse_env()
         except ConfigError as e:
             # a stray CEPH_TPU_* env var must not kill the daemon
-            dout(0, f"osd.{whoami}: ignoring bad env config: {e}")
+            dout("osd", 0, f"osd.{whoami}: ignoring bad env config: {e}")
         self.op_tracker = OpTracker()
         # distributed tracing (common/tracing.py): per-stage spans
         # under the client reqid, drained onto the MMgrReport push
@@ -376,6 +379,18 @@ class OSD(Dispatcher):
         # reported to the mon + report throttle stamp
         self._slow_ops_last_report = 0.0
         self._slow_ops_reported = 0
+        # cluster log (LogClient role): queued here, drained to the
+        # mon as MLog on the tick
+        self._log_client = LogClient(f"osd.{whoami}")
+        self.clog = self._log_client.channel()
+        # crash reports pending delivery to the mgr (piggybacked on
+        # the next MMgrReport push).  Sends are fire-and-forget, so
+        # one "successful" send proves nothing: each report rides
+        # several pushes (the mgr dedupes by crash_id) before we let
+        # go of our only copy
+        self._pending_crashes: deque = deque(maxlen=16)
+        self._crash_sends: dict[str, int] = {}
+        self.CRASH_RESEND_COUNT = 3
         self._mgr_addr: str | None = None
         self._mgr_conn = None
         self._mgr_addr_checked = 0.0
@@ -2556,10 +2571,20 @@ class OSD(Dispatcher):
                             self._scrub_pg(pg)
                     finally:
                         self._scrubbing.discard(item[1])
-            except Exception:  # noqa: BLE001 — worker must survive
+            except Exception as e:  # noqa: BLE001 — worker must
+                # survive, but the death of the op IS a daemon crash:
+                # capture traceback + dout tail for the mgr crash
+                # module and announce it on the cluster log
                 import traceback
 
                 traceback.print_exc()
+                crash_util.capture(
+                    f"osd.{self.whoami}",
+                    e,
+                    sink=self._pending_crashes,
+                    clog=self.clog,
+                    extra_meta={"work_item": str(kind)},
+                )
 
     def _peers_of_interest(self) -> set[int]:
         peers: set[int] = set()
@@ -2622,13 +2647,40 @@ class OSD(Dispatcher):
                 if self.config.get("tracing_enabled")
                 else []
             )
+            # crash reports ride the same push (MMgrReport piggyback).
+            # send() is fire-and-forget — an exception-free send does
+            # NOT prove delivery — so each report rides
+            # CRASH_RESEND_COUNT pushes before we drop our only copy
+            # (the mgr dedupes repeats by crash_id); removal targets
+            # the exact objects sent because capture() may append (or
+            # overflow-evict) concurrently
+            crashes = list(self._pending_crashes)
             self._mgr_conn.send(
                 MMgrReport(
                     daemon=f"osd.{self.whoami}",
                     perf=json.dumps(dump),
                     spans=json.dumps(spans),
+                    crashes=json.dumps(crashes),
                 )
             )
+            for sent in crashes:
+                cid = sent.get("crash_id", "")
+                sends = self._crash_sends.get(cid, 0) + 1
+                if sends < self.CRASH_RESEND_COUNT:
+                    self._crash_sends[cid] = sends
+                    continue
+                self._crash_sends.pop(cid, None)
+                try:
+                    self._pending_crashes.remove(sent)
+                except ValueError:
+                    pass  # evicted by overflow while we sent
+            # drop send-counts for reports overflow evicted mid-cycle
+            # (they will never hit the resend threshold)
+            live = {c.get("crash_id") for c in self._pending_crashes}
+            for cid in [
+                c for c in self._crash_sends if c not in live
+            ]:
+                del self._crash_sends[cid]
         except (MessageError, OSError, ValueError):
             self._mgr_conn = None
 
@@ -3069,108 +3121,126 @@ class OSD(Dispatcher):
 
     def _tick_loop(self) -> None:
         while not self._stop.wait(self.tick_interval):
-            now = time.monotonic()
-            # retry peering for primary PGs whose recovery pushes
-            # failed (peered_interval cleared) — at tick rate, never
-            # as a hot worker loop
-            retry = False
+            try:
+                self._tick()
+            except Exception as e:  # noqa: BLE001 — a tick crash is a
+                # daemon crash worth a report, but the ticker (and its
+                # heartbeats) must keep running
+                crash_util.capture(
+                    f"osd.{self.whoami}",
+                    e,
+                    sink=self._pending_crashes,
+                    clog=self.clog,
+                    extra_meta={"thread": "tick"},
+                )
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        # retry peering for primary PGs whose recovery pushes
+        # failed (peered_interval cleared) — at tick rate, never
+        # as a hot worker loop
+        retry = False
+        with self._pg_lock:
+            for pg in self.pgs.values():
+                if (
+                    pg.primary == self.whoami
+                    and pg.acting
+                    and pg.peered_interval is None
+                ):
+                    retry = True
+                    break
+        if retry:
+            self._workq.put(("map", self.monc.epoch))
+        # scheduled scrub: primary PGs past their stamp interval
+        # (OSD::sched_scrub's tick path)
+        if self.scrub_interval > 0:
             with self._pg_lock:
-                for pg in self.pgs.values():
-                    if (
-                        pg.primary == self.whoami
-                        and pg.acting
-                        and pg.peered_interval is None
-                    ):
-                        retry = True
-                        break
-            if retry:
-                self._workq.put(("map", self.monc.epoch))
-            # scheduled scrub: primary PGs past their stamp interval
-            # (OSD::sched_scrub's tick path)
-            if self.scrub_interval > 0:
-                with self._pg_lock:
-                    due = [
-                        pg.pgid
-                        for pg in self.pgs.values()
-                        if pg.primary == self.whoami
-                        and pg.state == "active"
-                        and now - pg.last_scrub > self.scrub_interval
-                        and pg.pgid not in self._scrubbing
-                    ]
-                for pgid in due:
-                    self._scrubbing.add(pgid)
-                    self._workq.enqueue(
-                        CLASS_BACKGROUND, 1, ("scrub", pgid)
-                    )
-            # cache-tier agent (TierAgentState flush/evict, scheduled
-            # like scrub, executed on the worker off the tick thread)
-            with self._pg_lock:
-                tier_due = [
+                due = [
                     pg.pgid
                     for pg in self.pgs.values()
                     if pg.primary == self.whoami
                     and pg.state == "active"
-                    and pg.pgid not in self._tier_running
-                    and (
-                        (p := self._pool_of(pg)) is not None
-                        and p.tier_of >= 0
-                        and p.cache_mode == "writeback"
-                    )
+                    and now - pg.last_scrub > self.scrub_interval
+                    and pg.pgid not in self._scrubbing
                 ]
-            for pgid in tier_due:
-                self._tier_running.add(pgid)
+            for pgid in due:
+                self._scrubbing.add(pgid)
                 self._workq.enqueue(
-                    CLASS_BACKGROUND, 1, ("tier_agent", pgid)
+                    CLASS_BACKGROUND, 1, ("scrub", pgid)
                 )
-            # mon session failover (MonClient reconnect)
+        # cache-tier agent (TierAgentState flush/evict, scheduled
+        # like scrub, executed on the worker off the tick thread)
+        with self._pg_lock:
+            tier_due = [
+                pg.pgid
+                for pg in self.pgs.values()
+                if pg.primary == self.whoami
+                and pg.state == "active"
+                and pg.pgid not in self._tier_running
+                and (
+                    (p := self._pool_of(pg)) is not None
+                    and p.tier_of >= 0
+                    and p.cache_mode == "writeback"
+                )
+            ]
+        for pgid in tier_due:
+            self._tier_running.add(pgid)
+            self._workq.enqueue(
+                CLASS_BACKGROUND, 1, ("tier_agent", pgid)
+            )
+        # mon session failover (MonClient reconnect)
+        try:
+            self.monc.ensure_connected()
+        except (MessageError, OSError):
+            pass
+        # re-announce until the map marks us up — a boot report
+        # can be lost while the mon quorum is electing
+        # (OSD::start_boot retries the same way)
+        osdmap = self.monc.osdmap
+        if (
+            osdmap is not None
+            and self.addr is not None
+            and not osdmap.is_up(self.whoami)
+        ):
             try:
-                self.monc.ensure_connected()
+                self.monc.boot(
+                    self.whoami,
+                    addr=f"{self.addr[0]}:{self.addr[1]}",
+                )
             except (MessageError, OSError):
                 pass
-            # re-announce until the map marks us up — a boot report
-            # can be lost while the mon quorum is electing
-            # (OSD::start_boot retries the same way)
-            osdmap = self.monc.osdmap
-            if (
-                osdmap is not None
-                and self.addr is not None
-                and not osdmap.is_up(self.whoami)
-            ):
-                try:
-                    self.monc.boot(
-                        self.whoami,
-                        addr=f"{self.addr[0]}:{self.addr[1]}",
+        interesting = self._peers_of_interest()
+        # peers that left every acting set (e.g. marked down) stop
+        # being tracked — a stale last-rx stamp would otherwise
+        # keep generating failure reports forever and instantly
+        # re-down a rebooted peer (the reference prunes its
+        # heartbeat_peers on map change too, OSD::maybe_update_heartbeat_peers)
+        for osd in self.hb.peers() - interesting:
+            self.hb.remove_peer(osd)
+        for osd in interesting:
+            if osd not in self.hb.peers():
+                self.hb.add_peer(osd, now)
+            try:
+                self._peer_conn(osd).send(
+                    MPing(
+                        tid=self.messenger.new_tid(),
+                        from_osd=self.whoami,
+                        stamp=now,
                     )
-                except (MessageError, OSError):
-                    pass
-            interesting = self._peers_of_interest()
-            # peers that left every acting set (e.g. marked down) stop
-            # being tracked — a stale last-rx stamp would otherwise
-            # keep generating failure reports forever and instantly
-            # re-down a rebooted peer (the reference prunes its
-            # heartbeat_peers on map change too, OSD::maybe_update_heartbeat_peers)
-            for osd in self.hb.peers() - interesting:
-                self.hb.remove_peer(osd)
-            for osd in interesting:
-                if osd not in self.hb.peers():
-                    self.hb.add_peer(osd, now)
-                try:
-                    self._peer_conn(osd).send(
-                        MPing(
-                            tid=self.messenger.new_tid(),
-                            from_osd=self.whoami,
-                            stamp=now,
-                        )
-                    )
-                except (MessageError, OSError, KeyError, ValueError):
-                    pass
-            for osd, silent_for in self.hb.failures(now):
-                try:
-                    self.monc.report_failure(osd, silent_for)
-                    self._reported.add(osd)
-                except (MessageError, OSError):
-                    pass
-            self._check_slow_ops(now)
+                )
+            except (MessageError, OSError, KeyError, ValueError):
+                pass
+        for osd, silent_for in self.hb.failures(now):
+            try:
+                self.monc.report_failure(osd, silent_for)
+                self._reported.add(osd)
+            except (MessageError, OSError):
+                pass
+        self._check_slow_ops(now)
+        self._flush_clog()
+
+    def _flush_clog(self) -> None:
+        self._log_client.flush(self.monc)
 
     def _check_slow_ops(self, now: float) -> None:
         """SLOW_OPS watchdog (OSD::check_ops_in_flight →
@@ -3199,6 +3269,17 @@ class OSD(Dispatcher):
                     "oldest_age": summary["oldest_age"],
                 }
             )
+            # clog the TRANSITIONS (not every refresh), and only
+            # AFTER the mon report succeeded — clogging before it
+            # would requeue one duplicate warn per tick for the whole
+            # length of a mon outage and bury the health timeline
+            if count > 0 and self._slow_ops_reported == 0:
+                self.clog.warn(
+                    f"{count} slow requests (oldest blocked for "
+                    f"{summary['oldest_age']:.0f} sec)"
+                )
+            elif count == 0 and self._slow_ops_reported > 0:
+                self.clog.info("slow requests cleared")
             self._slow_ops_reported = count
         except (MessageError, OSError, ValueError):
             pass

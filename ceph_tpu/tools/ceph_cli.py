@@ -121,11 +121,82 @@ def _build_command(args: list[str]) -> dict:
         return cmd
     if joined.startswith("config dump"):
         return {"prefix": "config dump"}
+    # exact-token match, NOT joined.startswith: `log "last words"`
+    # (one quoted arg) must inject an entry, never run the query
+    if args[0] == "log" and len(args) > 1 and args[1] == "last":
+        # log last [n] [level] [channel]
+        from ..common.log_client import CLOG_PRIOS
+
+        cmd = {"prefix": "log last"}
+        for a in args[2:]:
+            if a.isdigit():
+                cmd["num"] = int(a)
+            elif a in CLOG_PRIOS:
+                cmd["level"] = a
+            else:
+                cmd["channel"] = a
+        return cmd
+    if args[0] == "log" and len(args) > 1 and args[1] == "stat":
+        return {"prefix": "log stat"}
+    if args[0] == "log" and len(args) > 1:
+        return {"prefix": "log", "logtext": " ".join(args[1:])}
+    if joined.startswith(("health mute", "health unmute")):
+        if len(args) < 3:
+            raise SystemExit(f"health {args[1]} needs a check CODE")
+        if args[1] == "unmute":
+            return {"prefix": "health unmute", "code": args[2]}
+        # health mute CODE [--ttl SECONDS]
+        cmd = {"prefix": "health mute", "code": args[2]}
+        rest = args[3:]
+        if rest:
+            try:
+                raw = rest[1] if rest[0] == "--ttl" else rest[0]
+                cmd["ttl"] = float(raw)
+            except (IndexError, ValueError):
+                raise SystemExit(
+                    "health mute --ttl needs a number of seconds"
+                ) from None
+        return cmd
+    if args[0] == "crash":
+        # mgr-targeted (routed to the active mgr by main()):
+        # crash ls | info ID | stat | archive ID|all
+        sub = args[1] if len(args) > 1 else "ls"
+        if sub in ("ls", "stat"):
+            return {"prefix": f"crash {sub}"}
+        if sub == "info":
+            if len(args) < 3:
+                raise SystemExit("crash info needs a crash id")
+            return {"prefix": "crash info", "id": args[2]}
+        if sub == "archive":
+            if len(args) < 3:
+                # NEVER default to archive-all: clearing every crash
+                # (and RECENT_CRASH) from a missing argument is a
+                # destructive surprise — demand it by name
+                raise SystemExit(
+                    "crash archive needs an id (or the literal 'all')"
+                )
+            return {"prefix": "crash archive", "id": args[2]}
+        raise SystemExit(f"unknown crash subcommand {sub!r}")
     if args[0] in ("status", "health"):
         return {"prefix": args[0]}
     # pass-through: let the monitor reject unknowns (same as the
     # reference's validation living mon-side)
     return {"prefix": joined}
+
+
+def _mgr_command(msgr, mc, cmd: dict):
+    """Send a command to the active mgr (mgr-module surface)."""
+    from ..msg.message import MMonCommand, MMonCommandReply
+
+    reply = mc.command({"prefix": "mgr stat"})
+    active = json.loads(reply.outb).get("active") if reply.rc == 0 else None
+    if not active or not active.get("addr"):
+        raise SystemExit("no active mgr (is one running?)")
+    host, _, port = active["addr"].rpartition(":")
+    conn = msgr.connect(host, int(port))
+    out = conn.call(MMonCommand(cmd=json.dumps(cmd)))
+    assert isinstance(out, MMonCommandReply)
+    return out
 
 
 def main(argv=None) -> int:
@@ -149,7 +220,15 @@ def main(argv=None) -> int:
     try:
         mc = MonClient(msgr, whoami=-1)
         mc.connect(host, int(port))
-        reply = mc.command(_build_command(args.command))
+        cmd = _build_command(args.command)
+        prefix = cmd["prefix"]
+        if prefix == "crash" or prefix.startswith("crash "):
+            # mgr-module command: discover the active mgr through the
+            # monitor and send there (the reference CLI routes
+            # MgrCommands to the active mgr the same way)
+            reply = _mgr_command(msgr, mc, cmd)
+        else:
+            reply = mc.command(cmd)
     finally:
         msgr.shutdown()
 

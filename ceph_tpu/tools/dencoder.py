@@ -67,6 +67,13 @@ def _sample_messages():
         "MMgrReport": M.MMgrReport(
             daemon="osd.1", perf='{"op": 4}',
             spans='[{"trace_id": "t", "span_id": "s"}]',
+            crashes='[{"crash_id": "c", "entity_name": "osd.1"}]',
+        ),
+        "MLog": M.MLog(
+            name="osd.1",
+            entries='[{"name": "osd.1", "channel": "cluster", '
+            '"prio": "warn", "message": "m", "seq": 1, '
+            '"stamp": 1.5}]',
         ),
     }
     for name, msg in samples.items():

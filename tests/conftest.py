@@ -32,3 +32,22 @@ if os.environ.get("CEPH_TPU_LOCKDEP", "1") != "0":
     from ceph_tpu.common import lockdep as _lockdep
 
     _lockdep.enable()
+
+
+# The crash plane keeps a process-global pending queue for daemons
+# without an mgr session (ceph_tpu/common/crash.py).  Tests share one
+# process, so a crash captured by one test must not surface as
+# RECENT_CRASH in another test's manager: drain the queue between
+# tests.
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolate_global_crash_queue():
+    yield
+    from ceph_tpu.common import crash as _crash
+
+    _crash.drain_pending()
+    # signature-throttle history would suppress a later test's
+    # intentionally-identical crash injection
+    _crash.reset_throttle()

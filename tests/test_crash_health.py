@@ -1,0 +1,386 @@
+"""The cluster event plane (ISSUE 2): clog → mon `ceph log last`,
+crash capture → mgr crash module → RECENT_CRASH, health mutes, and
+the event-schema lint — the LogMonitor + mgr/crash + HealthMonitor
+mute roles end to end."""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import time
+
+import pytest
+
+from ceph_tpu.common import crash as crash_util
+from ceph_tpu.common.log import SUBSYSTEMS, Log
+from ceph_tpu.common.log_client import LogClient
+from ceph_tpu.mon.monitor import LogStore, MonitorStore
+from ceph_tpu.msg.message import MMonCommand
+from ceph_tpu.msg.messenger import wait_for
+
+from test_osd_daemon import MiniCluster
+
+sys.path.insert(
+    0, str(pathlib.Path(__file__).resolve().parent.parent / "tools")
+)
+
+
+# -- unit: LogClient / dout ring -------------------------------------------
+
+
+def test_log_client_entry_shape_drain_requeue():
+    lc = LogClient("osd.3", max_pending=4)
+    lc.channel().warn("w1")
+    lc.channel("audit").info("a1")
+    entries = lc.drain()
+    assert [e["prio"] for e in entries] == ["warn", "info"]
+    assert entries[0]["name"] == "osd.3"
+    assert entries[0]["channel"] == "cluster"
+    assert entries[1]["channel"] == "audit"
+    assert entries[0]["seq"] < entries[1]["seq"]
+    assert lc.drain() == []
+    # a failed send requeues IN ORDER ahead of new entries
+    lc.requeue(entries)
+    lc.channel().error("e1")
+    msgs = [e["message"] for e in lc.drain()]
+    assert msgs == ["w1", "a1", "e1"]
+    # bounded: flooding drops oldest, counted
+    for i in range(10):
+        lc.channel().debug(f"d{i}")
+    assert lc.pending_count() == 4
+    assert lc.entries_dropped > 0
+
+
+def test_subsystems_cover_daemon_modules():
+    """Satellite: every subsystem daemons log under has an explicit
+    level (no silent default-level fallback)."""
+    for subsys in ("mon", "mgr", "msg", "mds", "rgw", "rbd", "clog"):
+        assert subsys in SUBSYSTEMS, subsys
+
+
+def test_dump_recent_subsystem_filter():
+    lg = Log(max_recent=16)
+    lg.dout("osd", 1, "osd line")
+    lg.dout("mds", 1, "mds line")
+    assert {e["subsys"] for e in lg.dump_recent()} == {"osd", "mds"}
+    only = lg.dump_recent("mds")
+    assert len(only) == 1 and only[0]["message"] == "mds line"
+
+
+# -- unit: crash reports ----------------------------------------------------
+
+
+def test_crash_report_shape_and_lint():
+    import check_metrics
+
+    try:
+        raise ValueError("boom for the report")
+    except ValueError as e:
+        report = crash_util.capture("osd.7", e, sink=[])
+    assert report["entity_name"] == "osd.7"
+    assert "ValueError: boom for the report" == report["exception"]
+    assert any("boom for the report" in ln for ln in report["backtrace"])
+    # capture derrs first, so the ring tail always holds the crash line
+    assert any(
+        "osd.7 crashed" in e["message"] for e in report["dout_tail"]
+    )
+    assert check_metrics.check_crash_report(report) == []
+
+
+def test_check_metrics_catches_bad_event_schemas():
+    import check_metrics
+
+    errors = check_metrics.check_clog_entry(
+        {
+            "name": "x" * 100,
+            "channel": "bad channel!",
+            "prio": "shouting",
+            "message": 42,
+        }
+    )
+    assert any("missing field" in e for e in errors)  # stamp/seq
+    assert any("unknown prio" in e for e in errors)
+    assert any("channel" in e for e in errors)
+    assert any("name" in e for e in errors)
+    errors = check_metrics.check_crash_report(
+        {
+            "crash_id": "nope",
+            "entity_name": "osd.0",
+            "backtrace": "not a list",
+            "dout_tail": None,
+        }
+    )
+    assert any("crash_id" in e for e in errors)
+    assert any("backtrace" in e for e in errors)
+    assert any("dout_tail" in e for e in errors)
+    # and the real product shapes stay clean (tier-1 lint)
+    assert check_metrics.check_all() == []
+
+
+# -- unit: mon LogStore -----------------------------------------------------
+
+
+def test_logstore_bounds_filters_and_persistence():
+    store = MonitorStore()
+    ls = LogStore(store, max_entries=10)
+    now = time.time()
+    ls.add(
+        [
+            {
+                "name": f"osd.{i % 3}",
+                "stamp": now + i,
+                "channel": "audit" if i % 5 == 0 else "cluster",
+                "prio": "error" if i % 2 else "info",
+                "message": f"m{i}",
+                "seq": i,
+            }
+            for i in range(25)
+        ]
+    )
+    assert len(ls.last(100)) == 10  # bounded window
+    assert ls.total == 25  # totals keep counting past the window
+    assert ls.last(3)[-1]["message"] == "m24"
+    assert all(e["prio"] == "error" for e in ls.last(10, level="error"))
+    assert all(
+        e["channel"] == "audit" for e in ls.last(10, channel="audit")
+    )
+    by = ls.stat()["by_channel_prio"]
+    assert sum(by.values()) == 25
+    # a fresh LogStore over the same MonitorStore reloads the window
+    ls2 = LogStore(store, max_entries=10)
+    assert ls2.total == 25
+    assert [e["message"] for e in ls2.last(2)] == ["m23", "m24"]
+
+
+# -- integration ------------------------------------------------------------
+
+
+def _health(c):
+    reply = c.monc.command({"prefix": "health"})
+    assert reply.rc == 0, reply.outs
+    return json.loads(reply.outb)
+
+
+def _mgr_cmd(c, mgr, cmd: dict):
+    host, _, port = mgr.addr.rpartition(":")
+    conn = c.client_msgr.connect(host, int(port))
+    return conn.call(MMonCommand(cmd=json.dumps(cmd)))
+
+
+def test_event_plane_end_to_end(tmp_path):
+    """Acceptance: a daemon clog.error appears in `ceph log last`; an
+    OSD killed mid-write leaves a crash report (non-empty dout tail)
+    that raises RECENT_CRASH, `ceph crash ls/info/archive all` clears
+    it; `health mute` drops a code from the rollup (unmute/TTL
+    restores); everything surfaces as Prometheus families."""
+    import urllib.request
+
+    from ceph_tpu.mgr import Manager
+
+    c = MiniCluster()
+    mgr = None
+    try:
+        for i in range(3):
+            c.start_osd(i)
+        c.wait_active()
+        mgr = Manager(name="evt")
+        mgr.start(c.mon_addr)
+
+        # -- clog: daemon error → MLog → mon → `ceph log last`
+        c.osds[0].clog.error("osd.0 event-plane probe error")
+        def clog_arrived():
+            reply = c.monc.command(
+                {"prefix": "log last", "num": 50, "level": "error"}
+            )
+            return reply.rc == 0 and any(
+                "event-plane probe error" in e["message"]
+                for e in json.loads(reply.outb)
+            )
+        assert wait_for(clog_arrived, 15.0), "clog never reached mon"
+        # the mon clogs boots itself: the log is the cluster timeline
+        reply = c.monc.command({"prefix": "log last", "num": 100})
+        assert any(
+            "boot" in e["message"] for e in json.loads(reply.outb)
+        )
+        # level filter really filters
+        reply = c.monc.command(
+            {"prefix": "log last", "num": 100, "level": "error"}
+        )
+        assert all(
+            e["prio"] in ("error", "sec")
+            for e in json.loads(reply.outb)
+        )
+
+        # -- crash: kill an OSD mid-write (store raises under the op)
+        from ceph_tpu.msg import MOSDOp
+        from ceph_tpu.msg.message import OSD_OP_WRITEFULL
+        from test_osd_daemon import POOL
+
+        prim = c.primary_of("1.0")
+        victim = c.osds[prim]
+        orig = victim.store.queue_transaction
+        state = {"armed": True}
+
+        def dying(txn):
+            if state["armed"]:
+                state["armed"] = False
+                raise RuntimeError("injected store death mid-write")
+            return orig(txn)
+
+        victim.store.queue_transaction = dying
+        # fire-and-forget: the op dies inside the primary's worker,
+        # which is exactly the daemon-death path under test
+        conn = c.client_msgr.connect(*victim.addr)
+        conn.send(
+            MOSDOp(
+                tid=c.client_msgr.new_tid(),
+                pool=POOL, pgid="1.0", oid="crash-obj",
+                op=OSD_OP_WRITEFULL, data=b"x" * 64, length=-1,
+                reqid="crashtest.1", epoch=c.monc.epoch,
+            )
+        )
+        assert wait_for(lambda: not state["armed"], 15.0), (
+            "injected fault never fired"
+        )
+        victim.store.queue_transaction = orig
+
+        # crash report reaches the mgr with the dout ring tail, and
+        # RECENT_CRASH degrades health
+        def crash_raised():
+            return "RECENT_CRASH" in _health(c).get(
+                "checks_detail", {}
+            )
+        assert wait_for(crash_raised, 20.0), _health(c)
+        assert _health(c)["status"] == "HEALTH_WARN"
+        rows = json.loads(
+            _mgr_cmd(c, mgr, {"prefix": "crash ls"}).outb
+        )
+        ours = [
+            r for r in rows
+            if r["entity_name"] == f"osd.{prim}"
+            and "injected store death" in r["exception"]
+        ]
+        assert ours, rows
+        report = json.loads(
+            _mgr_cmd(
+                c, mgr,
+                {"prefix": "crash info", "id": ours[0]["crash_id"]},
+            ).outb
+        )
+        assert report["dout_tail"], "crash report lost the dout tail"
+        assert any(
+            "injected store death" in ln for ln in report["backtrace"]
+        )
+        stat = json.loads(
+            _mgr_cmd(c, mgr, {"prefix": "crash stat"}).outb
+        )
+        assert stat["total_ingested"] >= 1 and stat["recent"] >= 1
+        # the crash is also ON the cluster log (health timeline)
+        reply = c.monc.command(
+            {"prefix": "log last", "num": 100, "level": "error"}
+        )
+        assert any(
+            "crashed" in e["message"] for e in json.loads(reply.outb)
+        )
+
+        # -- prometheus: event families live while the check is active
+        port = mgr.modules["prometheus"].port
+        def scrape():
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode()
+        assert wait_for(
+            lambda: 'ceph_health_detail{name="RECENT_CRASH"'
+            in scrape(),
+            15.0,
+        ), scrape()
+        body = scrape()
+        assert "ceph_crash_reports_total" in body
+        assert "ceph_health_status 1" in body
+        assert 'ceph_cluster_log_messages_total{channel="cluster"' in body
+
+        # -- mute: drops the code from the rollup, keeps the detail
+        reply = c.monc.command(
+            {"prefix": "health mute", "code": "RECENT_CRASH"}
+        )
+        assert reply.rc == 0, reply.outs
+        h = _health(c)
+        assert h["status"] == "HEALTH_OK"
+        assert h["muted"] == ["RECENT_CRASH"]
+        assert h["checks_detail"]["RECENT_CRASH"]["muted"] is True
+        assert wait_for(
+            lambda: 'muted="true"' in scrape(), 15.0
+        )
+        # unmute restores the WARN
+        assert c.monc.command(
+            {"prefix": "health unmute", "code": "RECENT_CRASH"}
+        ).rc == 0
+        assert _health(c)["status"] == "HEALTH_WARN"
+        # TTL: expiry restores the check on its own
+        c.monc.command(
+            {"prefix": "health mute", "code": "RECENT_CRASH",
+             "ttl": 0.6}
+        )
+        assert _health(c)["status"] == "HEALTH_OK"
+        time.sleep(0.8)
+        assert _health(c)["status"] == "HEALTH_WARN"
+
+        # -- archive clears RECENT_CRASH through the mgr → mon path
+        reply = _mgr_cmd(
+            c, mgr, {"prefix": "crash archive", "id": "all"}
+        )
+        assert reply.rc == 0, reply.outs
+        assert wait_for(
+            lambda: _health(c)["status"] == "HEALTH_OK", 15.0
+        ), _health(c)
+        rows = json.loads(
+            _mgr_cmd(c, mgr, {"prefix": "crash ls"}).outb
+        )
+        assert rows and all(r["archived"] for r in rows)
+
+        # the per-OSD kill completes the thrash: the dead daemon stays
+        # down, the cluster log recorded its life
+        c.kill_osd(prim)
+        assert wait_for(
+            lambda: "OSD_DOWN" in _health(c).get("checks_detail", {}),
+            20.0,
+        )
+    finally:
+        if mgr is not None:
+            mgr.shutdown()
+        c.shutdown()
+
+
+def test_cli_builds_event_plane_commands():
+    from ceph_tpu.tools.ceph_cli import _build_command
+
+    assert _build_command(["log", "last", "30", "warn", "audit"]) == {
+        "prefix": "log last", "num": 30, "level": "warn",
+        "channel": "audit",
+    }
+    assert _build_command(["log", "hello", "world"]) == {
+        "prefix": "log", "logtext": "hello world",
+    }
+    assert _build_command(
+        ["health", "mute", "SLOW_OPS", "--ttl", "60"]
+    ) == {"prefix": "health mute", "code": "SLOW_OPS", "ttl": 60.0}
+    assert _build_command(["health", "unmute", "SLOW_OPS"]) == {
+        "prefix": "health unmute", "code": "SLOW_OPS",
+    }
+    assert _build_command(["crash", "ls"]) == {"prefix": "crash ls"}
+    assert _build_command(["crash", "archive", "all"]) == {
+        "prefix": "crash archive", "id": "all",
+    }
+    assert _build_command(["crash", "info", "abc"]) == {
+        "prefix": "crash info", "id": "abc",
+    }
+    # archive with no id must refuse, never default to archive-all
+    with pytest.raises(SystemExit):
+        _build_command(["crash", "archive"])
+    with pytest.raises(SystemExit):
+        _build_command(["crash", "frobnicate"])
+    # quoted free text starting with 'last' is an entry, not a query
+    assert _build_command(["log", "last words here"]) == {
+        "prefix": "log", "logtext": "last words here",
+    }

@@ -21,6 +21,12 @@ Checks, per counter set:
 The walked schemas are the product's real ones: the OSD daemon's
 counter block, the batched-mapping counters, and the device-kernel
 telemetry plane (after forcing registration of every group).
+
+The event-plane schemas are linted the same way: a real clog entry
+(common/log_client.py) and a real crash report (common/crash.py) are
+generated and checked for required fields, bounded sizes, and
+label-safe values — the shapes the mon LogStore, the mgr crash
+module, and the prometheus exporter all assume.
 """
 
 from __future__ import annotations
@@ -29,6 +35,129 @@ import re
 import sys
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+# -- event-plane schema bounds ---------------------------------------------
+CLOG_REQUIRED = ("name", "stamp", "channel", "prio", "message", "seq")
+CLOG_PRIOS = {"debug", "info", "warn", "error", "sec"}
+CLOG_MAX_MESSAGE = 4096
+CLOG_MAX_CHANNEL = 64
+CLOG_MAX_NAME = 64
+# channels/names become Prometheus label values and CLI columns:
+# printable, no control characters
+_LABEL_SAFE_RE = re.compile(r"^[\x20-\x7e]*$")
+_CHANNEL_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9_.-]*$")
+
+CRASH_REQUIRED = (
+    "crash_id", "entity_name", "timestamp", "timestamp_iso",
+    "exception", "backtrace", "dout_tail", "meta",
+)
+CRASH_ID_RE = re.compile(
+    r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{6}Z_[0-9a-f-]{36}$"
+)
+CRASH_MAX_BACKTRACE_LINES = 100
+CRASH_MAX_LINE = 2048
+CRASH_MAX_DOUT_TAIL = 200
+
+
+def check_clog_entry(entry) -> list[str]:
+    """Lint one cluster-log entry (LogClient/MLog/LogStore shape)."""
+    errors: list[str] = []
+    if not isinstance(entry, dict):
+        return ["clog entry: not a dict"]
+    for field in CLOG_REQUIRED:
+        if field not in entry:
+            errors.append(f"clog entry: missing field {field!r}")
+    prio = entry.get("prio")
+    if prio is not None and prio not in CLOG_PRIOS:
+        errors.append(f"clog entry: unknown prio {prio!r}")
+    channel = str(entry.get("channel", ""))
+    if len(channel) > CLOG_MAX_CHANNEL or not _CHANNEL_RE.match(
+        channel or "-"
+    ):
+        errors.append(
+            f"clog entry: channel {channel!r} unbounded or not "
+            "label-safe"
+        )
+    name = str(entry.get("name", ""))
+    if len(name) > CLOG_MAX_NAME or not _LABEL_SAFE_RE.match(name):
+        errors.append(
+            f"clog entry: name {name!r} unbounded or not label-safe"
+        )
+    message = entry.get("message", "")
+    if not isinstance(message, str) or len(message) > CLOG_MAX_MESSAGE:
+        errors.append("clog entry: message missing, non-str, or over "
+                      f"{CLOG_MAX_MESSAGE} bytes")
+    if not isinstance(entry.get("stamp", 0.0), (int, float)):
+        errors.append("clog entry: stamp is not a number")
+    if not isinstance(entry.get("seq", 0), int):
+        errors.append("clog entry: seq is not an int")
+    return errors
+
+
+def check_crash_report(report) -> list[str]:
+    """Lint one crash report (common/crash.py / mgr crash shape)."""
+    errors: list[str] = []
+    if not isinstance(report, dict):
+        return ["crash report: not a dict"]
+    for field in CRASH_REQUIRED:
+        if field not in report:
+            errors.append(f"crash report: missing field {field!r}")
+    cid = str(report.get("crash_id", ""))
+    if not CRASH_ID_RE.match(cid):
+        errors.append(
+            f"crash report: crash_id {cid!r} not <ISO stamp>_<uuid>"
+        )
+    entity = str(report.get("entity_name", ""))
+    if len(entity) > CLOG_MAX_NAME or not _LABEL_SAFE_RE.match(entity):
+        errors.append(
+            f"crash report: entity_name {entity!r} unbounded or not "
+            "label-safe"
+        )
+    bt = report.get("backtrace", [])
+    if not isinstance(bt, list) or not all(
+        isinstance(ln, str) for ln in bt
+    ):
+        errors.append("crash report: backtrace is not a list of str")
+    else:
+        if len(bt) > CRASH_MAX_BACKTRACE_LINES:
+            errors.append(
+                f"crash report: backtrace over "
+                f"{CRASH_MAX_BACKTRACE_LINES} lines"
+            )
+        if any(len(ln) > CRASH_MAX_LINE for ln in bt):
+            errors.append(
+                f"crash report: backtrace line over {CRASH_MAX_LINE}"
+            )
+    tail = report.get("dout_tail", [])
+    if not isinstance(tail, list) or len(tail) > CRASH_MAX_DOUT_TAIL:
+        errors.append(
+            f"crash report: dout_tail missing, non-list, or over "
+            f"{CRASH_MAX_DOUT_TAIL} entries"
+        )
+    if not isinstance(report.get("timestamp", 0.0), (int, float)):
+        errors.append("crash report: timestamp is not a number")
+    if not isinstance(report.get("meta", {}), dict):
+        errors.append("crash report: meta is not a dict")
+    return errors
+
+
+def product_event_samples() -> list[str]:
+    """Generate one real clog entry and one real crash report through
+    the product code paths and lint them — the schemas daemons
+    actually emit, not hand-written fixtures."""
+    from ceph_tpu.common import crash as crash_util
+    from ceph_tpu.common.log_client import LogClient
+
+    errors: list[str] = []
+    client = LogClient("osd.0")
+    entry = client.queue("cluster", "warn", "lint probe entry")
+    errors.extend(check_clog_entry(entry))
+    try:
+        raise RuntimeError("lint probe crash")
+    except RuntimeError as e:
+        report = crash_util.build_report("osd.0", e)
+    errors.extend(check_crash_report(report))
+    return errors
 
 
 def check_perf_counters(pc) -> list[str]:
@@ -80,6 +209,7 @@ def product_counter_sets():
 
 
 def check_all(sets=None) -> list[str]:
+    lint_events = sets is None
     sets = product_counter_sets() if sets is None else sets
     errors: list[str] = []
     cross: set[str] = set()
@@ -93,6 +223,10 @@ def check_all(sets=None) -> list[str]:
                     "after exporter name-flattening"
                 )
             cross.add(key)
+    if lint_events:
+        # product mode (no explicit sets): also lint the event-plane
+        # schemas the daemons really emit
+        errors.extend(product_event_samples())
     return errors
 
 
