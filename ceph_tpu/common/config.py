@@ -171,6 +171,39 @@ SCHEMA: dict[str, Option] = {
             min=1.0,
         ),
         Option(
+            "osd_max_scrubs",
+            OPT_INT,
+            1,
+            "concurrent scrubs an OSD runs or grants to primaries "
+            "(the scrub reservation cap, options.cc osd_max_scrubs)",
+            min=1,
+            level=LEVEL_BASIC,
+        ),
+        Option(
+            "osd_scrub_chunk_max",
+            OPT_INT,
+            25,
+            "objects digested per scrub chunk — the preemption "
+            "granularity (osd_scrub_chunk_max)",
+            min=1,
+        ),
+        Option(
+            "osd_scrub_auto_repair",
+            OPT_BOOL,
+            False,
+            "repair inconsistencies found by deep scrub "
+            "automatically (osd_scrub_auto_repair)",
+            level=LEVEL_BASIC,
+        ),
+        Option(
+            "osd_scrub_auto_repair_num_errors",
+            OPT_INT,
+            5,
+            "auto-repair only when deep scrub found at most this "
+            "many errors (osd_scrub_auto_repair_num_errors)",
+            min=1,
+        ),
+        Option(
             "tracing_enabled",
             OPT_BOOL,
             True,

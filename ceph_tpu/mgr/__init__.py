@@ -549,6 +549,31 @@ class PrometheusModule(MgrModule):
                     )
                 elif isinstance(val, (int, float)):
                     metric(base, val, help_, labels=labels)
+        # scrub plane (the data-integrity families): errors/progress/
+        # last-scrubbed age per daemon, lifted out of the generic
+        # per-daemon dump under their own stable names
+        scrub_families = (
+            ("scrub_errors", "ceph_osd_scrub_errors",
+             "open scrub inconsistencies per osd", "gauge"),
+            ("scrubs_active", "ceph_osd_scrubs_active",
+             "scrubs in flight per osd", "gauge"),
+            ("scrub_chunks", "ceph_osd_scrub_chunks_total",
+             "scrub chunks processed (progress)", "counter"),
+            ("scrub_last_age", "ceph_osd_scrub_last_age_seconds",
+             "seconds since the stalest primary pg was scrubbed",
+             "gauge"),
+        )
+        for daemon, dump in sorted(
+            (self.get("daemon_perf") or {}).items()
+        ):
+            for key, fam, help_, kind in scrub_families:
+                if key in dump and isinstance(
+                    dump[key], (int, float)
+                ):
+                    metric(
+                        fam, dump[key], help_,
+                        labels={"ceph_daemon": daemon}, kind=kind,
+                    )
         for entry in self.get("df")["pools"]:
             metric(
                 "ceph_pool_pg_num",

@@ -304,6 +304,11 @@ class Monitor(Dispatcher):
         # un-archived recent crash count, pushed by the mgr crash
         # module ("crash report") — raises RECENT_CRASH
         self.recent_crashes = 0
+        # scrub-error reports ("osd scrub errors" upcalls): daemon ->
+        # (wallclock received, error count, damaged pgids).  Feeds
+        # OSD_SCRUB_ERRORS / PG_DAMAGED; a zero report clears, stale
+        # reports age out like slow-op reports
+        self.scrub_reports: dict[str, tuple[float, int, list]] = {}
         # last health-check code set, so transitions (raise/clear)
         # write the cluster log — the health timeline
         self._prev_health: set[str] = set()
@@ -417,6 +422,39 @@ class Monitor(Dispatcher):
                     "have slow ops (SLOW_OPS)"
                 ),
             }
+        # OSD_SCRUB_ERRORS / PG_DAMAGED (scrub findings).  Unlike
+        # slow-op reports these must NOT age out on a timer — damage
+        # stays damaged until a repair's zero-report clears it (the
+        # reference keeps it in pg stats).  Only a reporter that left
+        # the cluster drops its contribution (its PGs re-scrub under
+        # their new primaries).
+        err_total, damaged = 0, set()
+        for daemon, (_ts, count, pgs) in list(
+            self.scrub_reports.items()
+        ):
+            try:
+                osd_id = int(daemon.rsplit(".", 1)[1])
+            except (IndexError, ValueError):
+                osd_id = -1
+            if osd_id >= 0 and not m.is_up(osd_id):
+                del self.scrub_reports[daemon]
+                continue
+            if count > 0:
+                err_total += count
+                damaged.update(pgs)
+        if err_total:
+            checks["OSD_SCRUB_ERRORS"] = {
+                "severity": "HEALTH_ERR",
+                "summary": f"{err_total} scrub errors",
+            }
+        if damaged:
+            checks["PG_DAMAGED"] = {
+                "severity": "HEALTH_ERR",
+                "summary": (
+                    f"Possible data damage: {len(damaged)} pg"
+                    f"{'s' if len(damaged) > 1 else ''} inconsistent"
+                ),
+            }
         if self.recent_crashes:
             checks["RECENT_CRASH"] = {
                 "severity": "HEALTH_WARN",
@@ -520,7 +558,7 @@ class Monitor(Dispatcher):
             "log last", "log stat",
             # periodic daemon chatter
             "mds beacon", "mgr beacon", "osd slow ops",
-            "crash report",
+            "crash report", "osd scrub errors",
         }
     )
 
@@ -899,7 +937,16 @@ def _cmd_health(mon: Monitor, cmd: dict) -> MMonCommandReply:
     _prune_mutes(mon)
     muted = {c for c in checks if c in mon.health_mutes}
     active = {c: v for c, v in checks.items() if c not in muted}
-    status = "HEALTH_OK" if not active else "HEALTH_WARN"
+    # the rollup takes the WORST active severity: scrub damage
+    # (OSD_SCRUB_ERRORS/PG_DAMAGED) is HEALTH_ERR, not a warning
+    if not active:
+        status = "HEALTH_OK"
+    elif any(
+        v.get("severity") == "HEALTH_ERR" for v in active.values()
+    ):
+        status = "HEALTH_ERR"
+    else:
+        status = "HEALTH_WARN"
     return MMonCommandReply(
         outs=status,
         outb=json.dumps(
@@ -1024,6 +1071,57 @@ def _cmd_osd_slow_ops(mon: Monitor, cmd: dict) -> MMonCommandReply:
     else:
         mon.slow_ops[daemon] = (time.time(), count, oldest)
     return MMonCommandReply(rc=0, outb=json.dumps({"ok": True}))
+
+
+def _cmd_osd_scrub_errors(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """Daemon → mon scrub-findings report (the pg-stats path that
+    feeds OSD_SCRUB_ERRORS/PG_DAMAGED in the reference).  A report of
+    0 errors — what a successful repair sends — clears the daemon's
+    contribution immediately."""
+    daemon = str(cmd.get("daemon", ""))
+    if not daemon:
+        return MMonCommandReply(rc=-22, outs="missing daemon")
+    errors = int(cmd.get("errors", 0))
+    pgs = [str(p) for p in cmd.get("pgs", [])]
+    if errors <= 0:
+        mon.scrub_reports.pop(daemon, None)
+    else:
+        mon.scrub_reports[daemon] = (time.time(), errors, pgs)
+    return MMonCommandReply(rc=0, outb=json.dumps({"ok": True}))
+
+
+def _cmd_pg_scrub(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """'ceph pg scrub|deep-scrub|repair <pgid>': validate the pg and
+    name its primary + address — the CLI dispatches the order to the
+    primary OSD directly (the mon→mgr→OSD scrub-order route of the
+    reference, collapsed to mon-names/client-dispatches)."""
+    what = str(cmd.get("prefix", "pg scrub"))[3:]
+    pgid = str(cmd.get("pgid", ""))
+    try:
+        pool_id, ps = (int(x) for x in pgid.split("."))
+    except ValueError:
+        return MMonCommandReply(rc=-22, outs=f"bad pgid {pgid!r}")
+    pool = mon.osdmap.pools.get(pool_id)
+    if pool is None or ps < 0 or ps >= pool.pg_num:
+        return MMonCommandReply(rc=-2, outs=f"pg {pgid} dne")
+    _up, _upp, _acting, primary = mon.osdmap.pg_to_up_acting_osds(
+        pool_id, ps
+    )
+    if primary < 0 or not mon.osdmap.is_up(primary):
+        return MMonCommandReply(
+            rc=-11, outs=f"pg {pgid} has no live primary (-EAGAIN)"
+        )
+    return MMonCommandReply(
+        outs=f"instructing pg {pgid} on osd.{primary} to {what}",
+        outb=json.dumps(
+            {
+                "pgid": pgid,
+                "op": what,
+                "primary": primary,
+                "addr": mon.osdmap.osd_addrs.get(primary, ""),
+            }
+        ),
+    )
 
 
 def _cmd_osd_tree(mon: Monitor, cmd: dict) -> MMonCommandReply:
@@ -1626,6 +1724,10 @@ _COMMANDS = {
     "log stat": _cmd_log_stat,
     "log": _cmd_log_inject,
     "osd slow ops": _cmd_osd_slow_ops,
+    "osd scrub errors": _cmd_osd_scrub_errors,
+    "pg scrub": _cmd_pg_scrub,
+    "pg deep-scrub": _cmd_pg_scrub,
+    "pg repair": _cmd_pg_scrub,
     "config set": _cmd_config_set,
     "config get": _cmd_config_get,
     "config dump": _cmd_config_dump,

@@ -44,6 +44,9 @@ from .message import (
     MPGPushReply,
     MPGQuery,
     MPing,
+    MRepScrub,
+    MScrubCommand,
+    MScrubMap,
     MWatchNotify,
     MWatchNotifyAck,
     Message,
@@ -76,6 +79,9 @@ __all__ = [
     "MPGPushReply",
     "MPGQuery",
     "MPing",
+    "MRepScrub",
+    "MScrubCommand",
+    "MScrubMap",
     "MWatchNotify",
     "MWatchNotifyAck",
     "Message",

@@ -1017,6 +1017,93 @@ class MMgrReport(Message):
 
 @register_message
 @dataclass
+class MRepScrub(Message):
+    """Primary → acting-set member scrub traffic (the MOSDRepScrub +
+    scrub-reservation roles, src/messages/MOSDRepScrub.h and the
+    ScrubReserver handshake):
+
+    - ``op="reserve"``/``"release"``: the osd_max_scrubs reservation
+      handshake — the replica grants or denies a scrub slot against
+      its own cap before the primary starts digesting chunks.
+    - ``op="ls"``: list this PG's object names, so the primary scrubs
+      objects it has itself lost.
+    - ``op="scan"``: build a digest map over ``oids`` (size + omap +
+      xattr digests; payload crc32c when ``deep``) — the MOSDRepScrub
+      → ScrubMap round, answered by MScrubMap."""
+
+    TYPE = 46
+    op: str = "scan"  # reserve | release | ls | scan
+    pgid: str = ""
+    epoch: int = 0
+    from_osd: int = -1
+    deep: bool = False
+    oids: list = field(default_factory=list)
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.op).string(self.pgid).u32(self.epoch)
+        e.s32(self.from_osd).bool(self.deep)
+        e.list(self.oids, lambda e2, o: e2.string(o))
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MRepScrub":
+        return cls(
+            op=d.string(), pgid=d.string(), epoch=d.u32(),
+            from_osd=d.s32(), deep=d.bool(),
+            oids=d.list(lambda d2: d2.string()),
+        )
+
+
+@register_message
+@dataclass
+class MScrubMap(Message):
+    """Acting-set member → primary scrub answer (the ScrubMap carry
+    of MOSDRepScrubMap): ``map_json`` is the JSON digest map for
+    ``scan`` (oid → {size, omap_digest, attrs_digest, data_digest,
+    hinfo}), the JSON name list for ``ls``, and empty for the
+    reservation verdicts, where ``ok`` is grant/deny."""
+
+    TYPE = 47
+    pgid: str = ""
+    from_osd: int = -1
+    ok: bool = True
+    error: str = ""
+    map_json: str = ""
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.pgid).s32(self.from_osd).bool(self.ok)
+        e.string(self.error).string(self.map_json)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MScrubMap":
+        return cls(
+            pgid=d.string(), from_osd=d.s32(), ok=d.bool(),
+            error=d.string(), map_json=d.string(),
+        )
+
+
+@register_message
+@dataclass
+class MScrubCommand(Message):
+    """Client/CLI → primary OSD scrub-plane command (the path `ceph
+    pg (deep-)scrub`, `ceph pg repair`, and `rados
+    list-inconsistent-obj` take after the mon names the primary —
+    the mgr→OSD scrub order of DaemonServer::handle_command).
+    Answered with an MMonCommandReply (rc/outs/outb)."""
+
+    TYPE = 48
+    op: str = "scrub"  # scrub | deep-scrub | repair | list-inconsistent-obj
+    pgid: str = ""
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.op).string(self.pgid)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MScrubCommand":
+        return cls(op=d.string(), pgid=d.string())
+
+
+@register_message
+@dataclass
 class MLog(Message):
     """Daemon → mon cluster-log batch (src/messages/MLog.h): the
     LogClient's drained entries (common/log_client.py shape, a JSON

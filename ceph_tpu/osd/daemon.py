@@ -81,6 +81,9 @@ from ..msg import (
     MPGPushReply,
     MPGQuery,
     MPing,
+    MRepScrub,
+    MScrubCommand,
+    MScrubMap,
 )
 from dataclasses import dataclass
 
@@ -124,7 +127,6 @@ from ..common.log import dout
 from ..common.log_client import LogClient
 from ..common import lockdep
 from ..mon.monitor import MonClient
-from ..native import ceph_crc32c
 from ..store.ec_store import ECStore, HINFO_KEY
 from ..store.objectstore import MemStore, ObjectStore, StoreError, Transaction
 from ..store.remote import RemoteStore, ShardServer
@@ -135,6 +137,7 @@ from .ec_pg import (
     shard_write_txn,
 )
 from .failure import HeartbeatTracker
+from .scrub import ScrubStore, Scrubber, build_scrub_map
 from .pg_log import (
     DELETE,
     EV_ZERO,
@@ -220,8 +223,10 @@ class PG:
         # pushes the divergent objects.
         self.repop_clean = False
         # scrub scheduling state (PG::ScrubberPasskey stamps,
-        # src/osd/PG.h:231-240): last completed stamp + findings
+        # src/osd/PG.h:231-240): last completed stamps + findings
+        # (the findings also persist in the ScrubStore omap)
         self.last_scrub = 0.0
+        self.last_deep_scrub = 0.0
         self.scrub_errors: list[dict] = []
 
 
@@ -258,6 +263,20 @@ def build_osd_perf(whoami: int):
         .add_u64_gauge(
             "slow_ops", "in-flight ops past the complaint time"
         )
+        # scrub plane (the l_osd_scrub* block): errors is the live
+        # inconsistency count across this OSD's primary PGs, chunks/
+        # deep_bytes are progress counters, last_age the staleness of
+        # the oldest primary PG's scrub stamp
+        .add_u64_gauge("scrub_errors", "open scrub inconsistencies")
+        .add_u64_gauge("scrubs_active", "scrubs in flight")
+        .add_u64_counter("scrub_chunks", "scrub chunks processed")
+        .add_u64_counter(
+            "scrub_deep_bytes", "object bytes deep-scrubbed"
+        )
+        .add_u64_gauge(
+            "scrub_last_age",
+            "seconds since the stalest primary pg was scrubbed",
+        )
         .create_perf_counters()
     )
 
@@ -270,16 +289,24 @@ class OSD(Dispatcher):
         tick_interval: float = 0.5,
         heartbeat_grace: float = 2.0,
         scrub_interval: float = 0.0,
+        deep_scrub_interval: float | None = None,
+        osd_max_scrubs: int | None = None,
+        scrub_auto_repair: bool | None = None,
         max_backfills: int = 2,
         admin_socket_path: str | None = None,
         client_message_cap: int = 256 << 20,
         op_queue: str = "wpq",
     ):
         """``scrub_interval`` > 0 arms tick-driven scrub scheduling
-        (osd_scrub_min_interval); ``max_backfills`` caps concurrent
-        per-(pg, peer) recoveries on BOTH sides of the reservation
-        protocol (osd_max_backfills) — individual pushes serialize
-        through the op scheduler's RECOVERY class."""
+        (osd_scrub_min_interval); ``deep_scrub_interval`` spaces the
+        payload-checksum passes (osd_deep_scrub_interval — None makes
+        every scheduled scrub deep); ``osd_max_scrubs`` caps
+        concurrent scrubs on BOTH sides of the scrub reservation
+        handshake; ``scrub_auto_repair`` overrides the
+        osd_scrub_auto_repair config; ``max_backfills`` caps
+        concurrent per-(pg, peer) recoveries on BOTH sides of the
+        reservation protocol (osd_max_backfills) — individual pushes
+        serialize through the op scheduler's RECOVERY class."""
         self.whoami = whoami
         self.store = store or MemStore()
         self.messenger = Messenger(f"osd.{whoami}")
@@ -362,6 +389,10 @@ class OSD(Dispatcher):
         self._notify_pending: dict[int, dict] = {}
         # scrub + recovery throttling
         self.scrub_interval = scrub_interval
+        self.deep_scrub_interval = deep_scrub_interval
+        # None = follow the osd_max_scrubs config option
+        self.osd_max_scrubs = osd_max_scrubs
+        self.scrub_auto_repair = scrub_auto_repair
         self.max_backfills = max(1, max_backfills)
         self._recovery_active = 0
         self.recovery_active_peak = 0  # high-water mark (perf gauge)
@@ -422,6 +453,10 @@ class OSD(Dispatcher):
         # last seen up/down per peer, to reset heartbeat stamps on a
         # down→up transition (a stale stamp would re-report instantly)
         self._last_up: dict[int, bool] = {}
+        # the scrub engine (osd/scrub.py): scheduling, reservations,
+        # chunked runs, the ScrubStore, and repair
+        self.scrubber = Scrubber(self)
+        self._boot_stamp = time.monotonic()
 
     # -- lifecycle ---------------------------------------------------------
     def boot(
@@ -2369,6 +2404,20 @@ class OSD(Dispatcher):
                 with self._recovery_lock:
                     self._remote_reservations.pop(key, None)
             return True
+        if isinstance(msg, MRepScrub):
+            if msg.op in ("reserve", "release"):
+                self._handle_rep_scrub(conn, msg)
+            else:
+                threading.Thread(
+                    target=self._handle_rep_scrub,
+                    args=(conn, msg),
+                    name=f"osd.{self.whoami}.scrubscan",
+                    daemon=True,
+                ).start()
+            return True
+        if isinstance(msg, MScrubCommand):
+            self._handle_scrub_command(conn, msg)
+            return True
         if isinstance(msg, MPGActivate):
             # rollback may re-pull objects (nested RPC) → worker queue
             self._workq.put(("activate", conn, msg))
@@ -2392,98 +2441,96 @@ class OSD(Dispatcher):
             return True
         return False
 
-    # -- scrub (PG::scrub via tick, src/osd/PG.h:231-240) ------------------
-    def _scrub_pg(self, pg: PG) -> None:
-        """Scheduled deep scrub: verify every object across the acting
-        set (crc compare on replicated pools; per-shard HashInfo audit
-        through the ECStore view on erasure pools), record findings,
-        stamp completion."""
-        if pg.primary != self.whoami or pg.state != "active":
-            return
-        try:
-            names = [
-                o
-                for o in self.store.list_objects(pg.cid)
-                if o.startswith(OBJ_PREFIX)
-            ]
-        except StoreError:
-            return
-        errors: list[dict] = []
-        osdmap = self.monc.osdmap
-        if self._is_ec(pg):
-            try:
-                ecs = self._ec_store_for(pg)
-            except StoreError:
-                return
-            for name in names:
-                try:
-                    res = ecs.scrub(name)
-                except (ErasureCodeError, StoreError):
-                    continue
-                if res.missing or res.corrupt or res.inconsistent:
-                    errors.append(
-                        {
-                            "oid": name[len(OBJ_PREFIX):],
-                            "missing": list(res.missing),
-                            "corrupt": list(res.corrupt),
-                            "inconsistent": res.inconsistent,
-                        }
-                    )
-        else:
-            peers = {}
-            for osd in pg.acting:
-                if (
-                    osd == self.whoami
-                    or osd == CRUSH_ITEM_NONE
-                    or not osdmap.is_up(osd)
-                ):
-                    continue
-                try:
-                    peers[osd] = RemoteStore(
-                        self._peer_conn(osd), timeout=10.0
-                    )
-                except (MessageError, OSError):
-                    continue
-            for name in names:
-                try:
-                    mine = ceph_crc32c(
-                        0, self.store.read(pg.cid, name)
-                    )
-                except StoreError:
-                    mine = None
-                for osd, rstore in peers.items():
-                    try:
-                        theirs = ceph_crc32c(
-                            0, rstore.read(pg.cid, name)
-                        )
-                    except StoreError:
-                        theirs = None
-                    if theirs != mine:
-                        errors.append(
-                            {
-                                "oid": name[len(OBJ_PREFIX):],
-                                "osd": osd,
-                                "primary_crc": mine,
-                                "peer_crc": theirs,
-                            }
-                        )
-        pg.scrub_errors = errors
-        pg.last_scrub = time.monotonic()
-        txn = Transaction().touch(pg.cid, PG_META)
-        txn.setattr(
-            pg.cid, PG_META, "scrub_stamp",
-            str(time.time()).encode(),
+    # -- scrub plane (osd/scrub.py drives; these are the wire ends) --------
+    def _handle_rep_scrub(self, conn: Connection, msg: MRepScrub):
+        """Acting-set member side of one scrub round: reservation
+        verdicts answer inline; ``ls``/``scan`` are local store reads
+        plus one batched digest pass — they run on a side thread so a
+        long digest can stall neither the messenger loop (heartbeats)
+        nor the worker (whose own in-flight scrub may be waiting on
+        THIS osd, the classic cross-scrub deadlock)."""
+        reply = MScrubMap(
+            tid=msg.tid, pgid=msg.pgid, from_osd=self.whoami
         )
+        pg = self.pgs.get(msg.pgid)
         try:
-            self.store.queue_transaction(txn)
-        except StoreError:
+            if msg.op == "reserve":
+                reply.ok = self.scrubber.handle_reserve(
+                    msg.pgid, msg.from_osd
+                )
+            elif msg.op == "release":
+                self.scrubber.handle_release(msg.pgid, msg.from_osd)
+            elif pg is None:
+                reply.ok = False
+                reply.error = f"pg {msg.pgid} unknown here"
+            elif msg.op == "ls":
+                names = [
+                    o
+                    for o in self.store.list_objects(pg.cid)
+                    if o.startswith(OBJ_PREFIX)
+                ]
+                reply.map_json = json.dumps(sorted(names))
+            elif msg.op == "scan":
+                reply.map_json = json.dumps(
+                    build_scrub_map(
+                        self.store, pg.cid, msg.oids, msg.deep,
+                        with_hinfo=self._is_ec(pg),
+                    )
+                )
+            else:
+                reply.ok = False
+                reply.error = f"unknown scrub op {msg.op!r}"
+        except StoreError as e:
+            reply.ok = False
+            reply.error = str(e)
+        try:
+            conn.send(reply)
+        except (MessageError, OSError):
             pass
-        if errors:
-            dout(
-                "osd", 1,
-                f"osd.{self.whoami} pg {pg.pgid} scrub found "
-                f"{len(errors)} inconsistencies",
+
+    def _handle_scrub_command(self, conn: Connection, msg: MScrubCommand):
+        """On-demand scrub plane (`ceph pg (deep-)scrub/repair`,
+        `rados list-inconsistent-obj`): the mon names this primary,
+        the client dispatches here.  Orders are acknowledged when
+        QUEUED (the reference's "instructing pg ..." contract);
+        list-inconsistent serves the persisted ScrubStore records."""
+        from ..msg.message import MMonCommandReply
+
+        reply = MMonCommandReply(tid=msg.tid)
+        pg = self.pgs.get(msg.pgid)
+        if (
+            pg is None
+            or pg.primary != self.whoami
+            or pg.state != "active"
+        ):
+            reply.rc = -11
+            reply.outs = f"not primary for pg {msg.pgid} (-EAGAIN)"
+        elif msg.op == "list-inconsistent-obj":
+            reply.outb = json.dumps(
+                {
+                    "epoch": self.monc.epoch,
+                    "inconsistents": ScrubStore.load(
+                        self.store, pg.cid
+                    ),
+                }
             )
+        elif msg.op in ("scrub", "deep-scrub", "repair"):
+            self.scrubber.request(
+                msg.pgid,
+                deep=msg.op != "scrub",
+                repair=msg.op == "repair",
+            )
+            reply.outs = (
+                f"instructing pg {msg.pgid} on osd.{self.whoami} "
+                f"to {msg.op}"
+            )
+        else:
+            reply.rc = -22
+            reply.outs = f"unknown scrub command {msg.op!r}"
+        try:
+            conn.send(reply)
+        except (MessageError, OSError):
+            pass
 
     def ms_handle_reset(self, conn: Connection) -> None:
         """A dead client connection takes its watches with it
@@ -2566,11 +2613,14 @@ class OSD(Dispatcher):
                         self._tier_running.discard(item[1])
                 elif kind == "scrub":
                     pg = self.pgs.get(item[1])
-                    try:
-                        if pg is not None:
-                            self._scrub_pg(pg)
-                    finally:
+                    if pg is None:
                         self._scrubbing.discard(item[1])
+                    else:
+                        # one CHUNK per work item: the scrubber
+                        # re-enqueues itself until done, so client
+                        # ops interleave between chunks (scrub
+                        # preemption); it owns the _scrubbing guard
+                        self.scrubber.run(pg, item[2], item[3])
             except Exception as e:  # noqa: BLE001 — worker must
                 # survive, but the death of the op IS a daemon crash:
                 # capture traceback + dout tail for the mgr crash
@@ -2630,6 +2680,22 @@ class OSD(Dispatcher):
                 return
             self.perf.set("numpg", len(self.pgs))
             self.perf.set("recovery_active", self._recovery_active)
+            # last-scrubbed age: the STALEST primary PG (feeds the
+            # ceph_osd_scrub_last_age_seconds prometheus family).  A
+            # never-scrubbed PG counts from daemon boot — reading 0
+            # there would make "never scrubbed" look like "just
+            # scrubbed", the one state a staleness alert exists for
+            mono = time.monotonic()
+            with self._pg_lock:
+                ages = [
+                    mono - (pg.last_scrub or self._boot_stamp)
+                    for pg in self.pgs.values()
+                    if pg.primary == self.whoami
+                    and pg.state == "active"
+                ]
+            self.perf.set(
+                "scrub_last_age", int(max(ages)) if ages else 0
+            )
             if self._mgr_conn is None or self._mgr_conn.is_closed:
                 host, _, port = self._mgr_addr.rpartition(":")
                 self._mgr_conn = self.messenger.connect(
@@ -3151,23 +3217,18 @@ class OSD(Dispatcher):
                     break
         if retry:
             self._workq.put(("map", self.monc.epoch))
-        # scheduled scrub: primary PGs past their stamp interval
-        # (OSD::sched_scrub's tick path)
-        if self.scrub_interval > 0:
-            with self._pg_lock:
-                due = [
-                    pg.pgid
-                    for pg in self.pgs.values()
-                    if pg.primary == self.whoami
-                    and pg.state == "active"
-                    and now - pg.last_scrub > self.scrub_interval
-                    and pg.pgid not in self._scrubbing
-                ]
-            for pgid in due:
-                self._scrubbing.add(pgid)
-                self._workq.enqueue(
-                    CLASS_BACKGROUND, 1, ("scrub", pgid)
-                )
+        # scheduled + on-demand scrub (OSD::sched_scrub's tick path:
+        # interval-due PGs plus `ceph pg (deep-)scrub/repair` orders)
+        for pgid, deep, repair in self.scrubber.due(now):
+            if pgid in self._scrubbing:
+                continue
+            self._scrubbing.add(pgid)
+            self._workq.enqueue(
+                CLASS_BACKGROUND, 1, ("scrub", pgid, deep, repair)
+            )
+        # withdraw/refresh the scrub-error health contribution when
+        # it changed (e.g. a damaged PG remapped away from us)
+        self.scrubber.maybe_report(now)
         # cache-tier agent (TierAgentState flush/evict, scheduled
         # like scrub, executed on the worker off the tick thread)
         with self._pg_lock:

@@ -138,6 +138,95 @@ class Rados:
             raise RadosError(reply.outs)
         self.monc.wait_for_epoch(json.loads(reply.outb)["epoch"])
 
+    # -- scrub plane (the `ceph pg *` / `rados list-inconsistent-*`
+    # surface: mon names the primary, client dispatches to it) -------------
+    def pg_command(self, pgid: str, op: str, timeout: float = 15.0):
+        """Send a scrub-plane command (scrub | deep-scrub | repair |
+        list-inconsistent-obj) to the pg's primary OSD, retrying
+        across -EAGAIN (re-peering / moved primary) like any op."""
+        import time as _time
+
+        from ..msg.message import (
+            MessageError,
+            MMonCommandReply,
+            MScrubCommand,
+        )
+
+        try:
+            pool_id, ps = (int(x) for x in pgid.split("."))
+        except ValueError:
+            raise RadosError(f"bad pgid {pgid!r} (-EINVAL)") from None
+        if pool_id < 0 or ps < 0:
+            raise RadosError(f"bad pgid {pgid!r} (-EINVAL)")
+        deadline = _time.monotonic() + timeout
+        last = "no attempt"
+        while _time.monotonic() < deadline:
+            osdmap = self.monc.osdmap
+            pool = osdmap.pools.get(pool_id)
+            if pool is None:
+                raise RadosError(f"pool {pool_id} dne (-ENOENT)")
+            if ps >= pool.pg_num:
+                # reject immediately, like the mon's pg validation —
+                # retrying a pg that cannot exist would burn the
+                # whole deadline on -EAGAIN noise
+                raise RadosError(f"pg {pgid} dne (-ENOENT)")
+            _u, _upp, _a, primary = osdmap.pg_to_up_acting_osds(
+                pool_id, ps
+            )
+            addr = osdmap.osd_addrs.get(primary, "")
+            if primary < 0 or not addr:
+                last = f"pg {pgid} has no live primary"
+                _time.sleep(0.2)
+                continue
+            host, _, port = addr.rpartition(":")
+            try:
+                conn = self.messenger.connect(host, int(port))
+                reply = conn.call(
+                    MScrubCommand(
+                        tid=self.messenger.new_tid(),
+                        op=op, pgid=pgid,
+                    ),
+                    timeout=max(1.0, deadline - _time.monotonic()),
+                )
+            except (MessageError, OSError) as e:
+                last = str(e)
+                _time.sleep(0.2)
+                continue
+            if isinstance(reply, MMonCommandReply):
+                if reply.rc == -11:
+                    last = reply.outs
+                    _time.sleep(0.2)
+                    continue
+                return reply
+            last = f"unexpected reply {type(reply).__name__}"
+            _time.sleep(0.2)
+        raise RadosError(f"pg {pgid} {op} failed: {last}")
+
+    def pg_scrub(self, pgid: str, deep: bool = False) -> str:
+        """`ceph pg (deep-)scrub` — returns the primary's ack text."""
+        reply = self.pg_command(
+            pgid, "deep-scrub" if deep else "scrub"
+        )
+        if reply.rc != 0:
+            raise RadosError(reply.outs)
+        return reply.outs
+
+    def pg_repair(self, pgid: str) -> str:
+        """`ceph pg repair` — authoritative-copy repair of recorded
+        inconsistencies, pushed through the recovery path."""
+        reply = self.pg_command(pgid, "repair")
+        if reply.rc != 0:
+            raise RadosError(reply.outs)
+        return reply.outs
+
+    def list_inconsistent_obj(self, pgid: str) -> list[dict]:
+        """`rados list-inconsistent-obj <pgid>`: the pg's persisted
+        ScrubStore records (structured findings, post-hoc)."""
+        reply = self.pg_command(pgid, "list-inconsistent-obj")
+        if reply.rc != 0:
+            raise RadosError(reply.outs)
+        return json.loads(reply.outb).get("inconsistents", [])
+
     def open_ioctx(self, pool_name: str) -> "IoCtx":
         return IoCtx(self, self.pool_lookup(pool_name))
 

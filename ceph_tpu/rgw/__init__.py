@@ -216,7 +216,26 @@ class RGW:
         # bucket -> (stamp, rules); see cors_match
         self._cors_cache: dict[str, tuple] = {}
         self._datalog_lock = threading.Lock()
+        # per-bucket serialization of bucket-record read-modify-
+        # writes (ACL/CORS/lifecycle): two handler threads updating
+        # different fields of one record would otherwise each
+        # read-modify-write the whole JSON blob and silently drop
+        # the other's change (the cls_rgw bucket-index op atomicity
+        # the omap blob cannot give us)
+        self._bucket_locks: dict[str, threading.Lock] = {}
+        self._bucket_locks_guard = threading.Lock()
+        # LC_OID create-on-first-use: write_full on an existing
+        # object wipes its omap, so creation must be serialized or a
+        # losing racer erases another bucket's freshly-set rules
+        self._lc_lock = threading.Lock()
         self._datalog_seq: int | None = None
+
+    def _bucket_lock(self, bucket: str) -> threading.Lock:
+        with self._bucket_locks_guard:
+            lock = self._bucket_locks.get(bucket)
+            if lock is None:
+                lock = self._bucket_locks[bucket] = threading.Lock()
+            return lock
 
     # -- datalog (rgw datalog/mdlog role, feeding multisite.py) ------------
     def _log_change(self, op: str, bucket: str, key: str | None,
@@ -436,13 +455,14 @@ class RGW:
     def set_bucket_acl(
         self, bucket: str, canned: str, user=SYSTEM
     ) -> None:
-        rec = self._bucket_rec(bucket)
-        self._require(
-            user, aclmod.WRITE_ACP, rec.get("acl"),
-            rec.get("owner"), bucket,
-        )
-        rec["acl"] = aclmod.make_acl(rec.get("owner"), canned)
-        self._save_bucket_rec(bucket, rec)
+        with self._bucket_lock(bucket):
+            rec = self._bucket_rec(bucket)
+            self._require(
+                user, aclmod.WRITE_ACP, rec.get("acl"),
+                rec.get("owner"), bucket,
+            )
+            rec["acl"] = aclmod.make_acl(rec.get("owner"), canned)
+            self._save_bucket_rec(bucket, rec)
         self._log_change("bucket_acl", bucket, None, user)
 
     def get_bucket_acl(self, bucket: str, user=SYSTEM) -> dict:
@@ -528,8 +548,12 @@ class RGW:
                 "strings) and allowed_methods (list from "
                 "GET/PUT/POST/DELETE/HEAD)"
             )
-        rec["cors"] = rules
-        self._save_bucket_rec(bucket, rec)
+        with self._bucket_lock(bucket):
+            # re-read under the lock: the record checked above may
+            # have been rewritten by a concurrent ACL update
+            rec = self._bucket_rec(bucket)
+            rec["cors"] = rules
+            self._save_bucket_rec(bucket, rec)
         self._cors_cache.pop(bucket, None)
         self._log_change("bucket_acl", bucket, None, user)
 
@@ -539,10 +563,11 @@ class RGW:
         return rec.get("cors", [])
 
     def delete_bucket_cors(self, bucket: str, user=SYSTEM) -> None:
-        rec = self._bucket_rec(bucket)
-        self._require_owner(user, rec, bucket)
-        rec.pop("cors", None)
-        self._save_bucket_rec(bucket, rec)
+        with self._bucket_lock(bucket):
+            rec = self._bucket_rec(bucket)
+            self._require_owner(user, rec, bucket)
+            rec.pop("cors", None)
+            self._save_bucket_rec(bucket, rec)
         self._cors_cache.pop(bucket, None)
         self._log_change("bucket_acl", bucket, None, user)
 
@@ -727,13 +752,14 @@ class RGW:
                         raise RGWError(f"{f} must be numeric")
             if not isinstance(rule.get("prefix", ""), str):
                 raise RGWError("prefix must be a string")
-        try:
-            self.io.stat(LC_OID)
-        except (ObjectNotFound, RadosError):
-            self.io.write_full(LC_OID, b"")
-        self.io.omap_set(
-            LC_OID, {bucket: json.dumps(rules).encode()}
-        )
+        with self._bucket_lock(bucket), self._lc_lock:
+            try:
+                self.io.stat(LC_OID)
+            except (ObjectNotFound, RadosError):
+                self.io.write_full(LC_OID, b"")
+            self.io.omap_set(
+                LC_OID, {bucket: json.dumps(rules).encode()}
+            )
         self._log_change("lifecycle", bucket, None, user)
 
     def get_bucket_lifecycle(self, bucket: str, user=SYSTEM) -> list:
@@ -748,7 +774,8 @@ class RGW:
     def delete_bucket_lifecycle(self, bucket: str, user=SYSTEM) -> None:
         rec = self._bucket_rec(bucket)
         self._require_owner(user, rec, bucket)
-        self.io.omap_rm_keys(LC_OID, [bucket])
+        with self._bucket_lock(bucket):
+            self.io.omap_rm_keys(LC_OID, [bucket])
         self._log_change("lifecycle", bucket, None, user)
 
     def lc_process(self, debug: bool | None = None) -> dict:

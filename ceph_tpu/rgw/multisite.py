@@ -134,9 +134,16 @@ class SyncAgent:
         except (ObjectNotFound, RGWError):
             return  # raced a delete; the datalog entry will follow
         self.dst.put_object(bucket, key, data, user=SYNC_USER)
-        # carry the index metadata the put reset (owner/acl/class)
+        # carry the index metadata the put reset (owner/acl).  NOT
+        # storage_class: the copy lands the UNCOMPRESSED head bytes,
+        # so stamping the source's COLD class would make the replica
+        # claim a transition that never happened (reads would try the
+        # compressed-payload path against plain bytes, and the
+        # destination LC would skip the object forever); leaving the
+        # class STANDARD lets the destination's own lifecycle
+        # re-transition it for real
         dentry = self.dst.stat_object(bucket, key)
-        for k in ("owner", "acl", "storage_class"):
+        for k in ("owner", "acl"):
             if k in entry:
                 dentry[k] = entry[k]
         self.dst.io.omap_set(

@@ -107,6 +107,12 @@ def _build_command(args: list[str]) -> dict:
         return {"prefix": "osd dump"}
     if joined.startswith("pg dump"):
         return {"prefix": "pg dump"}
+    if joined.startswith(("pg scrub", "pg deep-scrub", "pg repair")):
+        # pg scrub|deep-scrub|repair PGID — the mon validates and
+        # names the primary; main() dispatches the order to it
+        if len(args) < 3:
+            raise SystemExit(f"pg {args[1]} needs a PGID")
+        return {"prefix": f"pg {args[1]}", "pgid": args[2]}
     if joined.startswith("config set"):
         return {
             "prefix": "config set",
@@ -227,6 +233,22 @@ def main(argv=None) -> int:
             # monitor and send there (the reference CLI routes
             # MgrCommands to the active mgr the same way)
             reply = _mgr_command(msgr, mc, cmd)
+        elif prefix in ("pg scrub", "pg deep-scrub", "pg repair"):
+            # scrub-plane order: the mon validates the pg and names
+            # the primary; the CLI dispatches the order there
+            reply = mc.command(cmd)
+            if reply.rc == 0 and reply.outb:
+                from ..msg.message import MScrubCommand
+
+                target = json.loads(reply.outb)
+                host, _, port = target["addr"].rpartition(":")
+                conn = msgr.connect(host, int(port))
+                reply = conn.call(
+                    MScrubCommand(
+                        tid=msgr.new_tid(),
+                        op=target["op"], pgid=target["pgid"],
+                    )
+                )
         else:
             reply = mc.command(cmd)
     finally:

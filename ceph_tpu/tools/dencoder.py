@@ -75,6 +75,18 @@ def _sample_messages():
             '"prio": "warn", "message": "m", "seq": 1, '
             '"stamp": 1.5}]',
         ),
+        "MRepScrub": M.MRepScrub(
+            op="scan", pgid="1.3", epoch=42, from_osd=0,
+            deep=True, oids=["o_a", "o_b"],
+        ),
+        "MScrubMap": M.MScrubMap(
+            pgid="1.3", from_osd=2, ok=True, error="",
+            map_json='{"o_a": {"exists": true, "size": 11, '
+            '"data_digest": 7}}',
+        ),
+        "MScrubCommand": M.MScrubCommand(
+            op="deep-scrub", pgid="1.3"
+        ),
     }
     for name, msg in samples.items():
         msg.tid = 99

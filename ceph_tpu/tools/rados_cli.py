@@ -6,6 +6,7 @@
     ... get OBJ FILE | rm OBJ | ls | stat OBJ
     ... setomapval OBJ KEY VALUE | listomapvals OBJ | rmomapkey OBJ KEY
     ... mksnap NAME | rmsnap NAME | lssnap
+    ... list-inconsistent-obj PGID
     ... bench SECONDS write|read [--obj-size N] [--concurrent N]
 """
 
@@ -121,6 +122,20 @@ def main(argv=None) -> int:
         elif cmd == "lssnap":
             for sid, name in sorted(io.snap_list().items()):
                 print(f"{sid}\t{name}")
+        elif cmd == "list-inconsistent-obj":
+            # the pg's persisted ScrubStore findings, served by its
+            # primary (src/tools/rados/rados.cc do_get_inconsistent)
+            print(
+                json.dumps(
+                    {
+                        "epoch": r.monc.epoch,
+                        "inconsistents": r.list_inconsistent_obj(
+                            rest[0]
+                        ),
+                    },
+                    indent=2,
+                )
+            )
         elif cmd == "bench":
             seconds, mode = int(rest[0]), rest[1]
             _bench(

@@ -1,0 +1,342 @@
+"""End-to-end data-integrity flow on a live mini-cluster (the
+qa/standalone/scrub tier analog): inject bit-rot → on-demand deep
+scrub detects → `rados list-inconsistent-obj` serves records →
+`ceph pg repair` restores byte-identical data → OSD_SCRUB_ERRORS /
+PG_DAMAGED raise then clear — on replicated AND erasure pools."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from ceph_tpu.msg.messenger import wait_for
+from ceph_tpu.osd.daemon import OBJ_PREFIX
+from ceph_tpu.osdc.objecter import object_to_pg
+from ceph_tpu.rados import Rados
+from ceph_tpu.store.objectstore import Transaction
+
+from test_osd_daemon import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster()
+    for i in range(3):
+        c.start_osd(i)  # scrub_interval=0: on-demand orders only
+    c.wait_active()
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    r = Rados("scrub-repair-test").connect(*cluster.mon_addr)
+    r.pool_create("rp", pg_num=2, size=3)
+    rc, _outb, outs = r.mon_command(
+        {
+            "prefix": "osd erasure-code-profile set",
+            "name": "sr_ec",
+            "profile": ["k=2", "m=1", "plugin=jerasure"],
+        }
+    )
+    assert rc == 0, outs
+    r.pool_create(
+        "ep", pool_type=3, pg_num=2,
+        erasure_code_profile="sr_ec", min_size=2,
+    )
+    try:
+        yield r
+    finally:
+        r.shutdown()
+
+
+def _pgid_of(client, pool_name, oid):
+    pool_id = client.pool_lookup(pool_name)
+    return object_to_pg(client.monc.osdmap.pools[pool_id], oid)
+
+
+def _health(client):
+    rc, outb, outs = client.mon_command({"prefix": "health"})
+    assert rc == 0, outs
+    return json.loads(outb)
+
+
+def _wait_check(client, code, present, timeout=20.0):
+    return wait_for(
+        lambda: (code in _health(client)["checks_detail"]) == present,
+        timeout,
+    )
+
+
+def test_replicated_bitrot_detect_report_repair(cluster, client):
+    io = client.open_ioctx("rp")
+    payload = b"pristine replicated payload " * 64
+    io.write_full("victim", payload)
+    pgid = _pgid_of(client, "rp", "victim")
+    # bit-rot on one non-primary replica, directly in its store
+    primary = cluster.osds[
+        client.monc.osdmap.pg_to_up_acting_osds(
+            client.pool_lookup("rp"), int(pgid.split(".")[1])
+        )[3]
+    ]
+    pg = primary.pgs[pgid]
+    replica = next(o for o in pg.acting if o != primary.whoami)
+    rstore = cluster.osds[replica].store
+    rotted = bytearray(payload)
+    rotted[17] ^= 0x40
+    rstore.queue_transaction(
+        Transaction().write(
+            pg.cid, OBJ_PREFIX + "victim", 0, bytes(rotted)
+        )
+    )
+    # deep scrub detects, the ScrubStore serves structured findings
+    assert "deep-scrub" in client.pg_scrub(pgid, deep=True)
+    assert wait_for(
+        lambda: any(
+            r["object"]["name"] == "victim"
+            for r in client.list_inconsistent_obj(pgid)
+        ),
+        20.0,
+    ), "deep scrub never recorded the planted bit-rot"
+    rec = next(
+        r
+        for r in client.list_inconsistent_obj(pgid)
+        if r["object"]["name"] == "victim"
+    )
+    bad = [sh for sh in rec["shards"] if sh["errors"]]
+    assert [sh["osd"] for sh in bad] == [replica]
+    assert "data_digest_mismatch" in bad[0]["errors"]
+    assert rec["selected_object_info"]["osd"] != replica
+    # health degrades: OSD_SCRUB_ERRORS + PG_DAMAGED
+    assert _wait_check(client, "OSD_SCRUB_ERRORS", True)
+    assert _wait_check(client, "PG_DAMAGED", True)
+    # repair restores byte-identical data everywhere and clears
+    assert "repair" in client.pg_repair(pgid)
+    assert wait_for(
+        lambda: rstore.read(pg.cid, OBJ_PREFIX + "victim")
+        == payload,
+        20.0,
+    ), "repair never rewrote the rotted replica"
+    assert io.read("victim") == payload
+    assert wait_for(
+        lambda: client.list_inconsistent_obj(pgid) == [], 20.0
+    )
+    assert _wait_check(client, "OSD_SCRUB_ERRORS", False)
+    assert _wait_check(client, "PG_DAMAGED", False)
+
+
+def test_ec_shard_bitrot_detect_repair(cluster, client):
+    io = client.open_ioctx("ep")
+    payload = b"erasure coded integrity payload " * 128
+    io.write_full("shardy", payload)
+    pgid = _pgid_of(client, "ep", "shardy")
+    primary = cluster.osds[
+        client.monc.osdmap.pg_to_up_acting_osds(
+            client.pool_lookup("ep"), int(pgid.split(".")[1])
+        )[3]
+    ]
+    pg = primary.pgs[pgid]
+    victim_osd = next(o for o in pg.acting if o != primary.whoami)
+    victim_pos = pg.acting.index(victim_osd)
+    vstore = cluster.osds[victim_osd].store
+    raw = bytearray(vstore.read(pg.cid, OBJ_PREFIX + "shardy"))
+    before = bytes(raw)
+    raw[7] ^= 0x01
+    vstore.queue_transaction(
+        Transaction().write(
+            pg.cid, OBJ_PREFIX + "shardy", 0, bytes(raw)
+        )
+    )
+    assert "deep-scrub" in client.pg_scrub(pgid, deep=True)
+    assert wait_for(
+        lambda: any(
+            r["object"]["name"] == "shardy" and r.get("corrupt")
+            for r in client.list_inconsistent_obj(pgid)
+        ),
+        20.0,
+    ), "EC deep scrub never flagged the rotted shard"
+    rec = next(
+        r
+        for r in client.list_inconsistent_obj(pgid)
+        if r["object"]["name"] == "shardy"
+    )
+    assert rec["corrupt"] == [victim_pos]
+    bad = [sh for sh in rec["shards"] if sh["errors"]]
+    assert bad[0]["osd"] == victim_osd
+    assert "ec_hash_mismatch" in bad[0]["errors"]
+    assert _wait_check(client, "OSD_SCRUB_ERRORS", True)
+    # repair reconstructs the shard from the survivors: byte-identical
+    assert "repair" in client.pg_repair(pgid)
+    assert wait_for(
+        lambda: vstore.read(pg.cid, OBJ_PREFIX + "shardy") == before,
+        20.0,
+    ), "repair never rebuilt the rotted shard"
+    assert io.read("shardy") == payload
+    assert wait_for(
+        lambda: client.list_inconsistent_obj(pgid) == [], 20.0
+    )
+    assert _wait_check(client, "OSD_SCRUB_ERRORS", False)
+
+
+def test_scrubstore_persists_and_shallow_catches_size(cluster, client):
+    """Shallow scrub (metadata compare) catches a size divergence,
+    and the findings persist in the ScrubStore omap (served after the
+    scrub, not just during it)."""
+    from ceph_tpu.osd.scrub import SCRUB_META, ScrubStore
+
+    io = client.open_ioctx("rp")
+    io.write_full("sized", b"twelve bytes")
+    pgid = _pgid_of(client, "rp", "sized")
+    primary = cluster.osds[
+        client.monc.osdmap.pg_to_up_acting_osds(
+            client.pool_lookup("rp"), int(pgid.split(".")[1])
+        )[3]
+    ]
+    pg = primary.pgs[pgid]
+    replica = next(o for o in pg.acting if o != primary.whoami)
+    rstore = cluster.osds[replica].store
+    rstore.queue_transaction(
+        Transaction().write(
+            pg.cid, OBJ_PREFIX + "sized", 12, b"EXTRA"
+        )
+    )
+    assert "scrub" in client.pg_scrub(pgid, deep=False)
+    assert wait_for(
+        lambda: any(
+            r["object"]["name"] == "sized"
+            and any(
+                "size_mismatch" in sh["errors"]
+                for sh in r["shards"]
+            )
+            for r in client.list_inconsistent_obj(pgid)
+        ),
+        20.0,
+    ), "shallow scrub never flagged the size divergence"
+    # the records are really IN the omap of the _scrub_ object
+    stored = ScrubStore.load(primary.store, pg.cid)
+    assert any(r["object"]["name"] == "sized" for r in stored)
+    assert primary.store.exists(pg.cid, SCRUB_META)
+    # repair then clears the record
+    client.pg_repair(pgid)
+    assert wait_for(
+        lambda: client.list_inconsistent_obj(pgid) == [], 20.0
+    )
+    assert io.read("sized") == b"twelve bytes"
+
+
+def test_scrub_reservations_respect_cap(cluster, client):
+    """The osd_max_scrubs ledger: a replica at its cap denies, a
+    release frees the slot (the ScrubReserver handshake)."""
+    osd = next(iter(cluster.osds.values()))
+    scr = osd.scrubber
+    assert scr.max_scrubs == 1
+    assert scr.handle_reserve("9.0", 7) is True
+    assert scr.handle_reserve("9.1", 8) is False  # cap reached
+    assert scr.handle_reserve("9.0", 7) is True  # re-grant same key
+    scr.handle_release("9.0", 7)
+    assert scr.handle_reserve("9.1", 8) is True
+    scr.handle_release("9.1", 8)
+
+
+def test_shallow_scrub_preserves_deep_findings(cluster, client):
+    """A shallow pass is blind to payload corruption: it must carry
+    forward deep findings (never clear OSD_SCRUB_ERRORS raised by a
+    deep scrub); only repair re-judges and clears them."""
+    io = client.open_ioctx("rp")
+    payload = b"deep finding survivor " * 40
+    io.write_full("keeper", payload)
+    pgid = _pgid_of(client, "rp", "keeper")
+    primary = cluster.osds[
+        client.monc.osdmap.pg_to_up_acting_osds(
+            client.pool_lookup("rp"), int(pgid.split(".")[1])
+        )[3]
+    ]
+    pg = primary.pgs[pgid]
+    replica = next(o for o in pg.acting if o != primary.whoami)
+    rstore = cluster.osds[replica].store
+    rotted = bytearray(payload)
+    rotted[5] ^= 0x10  # same size: invisible to a shallow pass
+    rstore.queue_transaction(
+        Transaction().write(
+            pg.cid, OBJ_PREFIX + "keeper", 0, bytes(rotted)
+        )
+    )
+    client.pg_scrub(pgid, deep=True)
+    assert wait_for(
+        lambda: any(
+            r["object"]["name"] == "keeper"
+            for r in client.list_inconsistent_obj(pgid)
+        ),
+        20.0,
+    )
+    # shallow scrub: cannot see the rot, must not wipe the record
+    client.pg_scrub(pgid, deep=False)
+    assert wait_for(
+        lambda: pg.last_scrub > pg.last_deep_scrub, 20.0
+    ), "shallow scrub never completed"
+    assert any(
+        r["object"]["name"] == "keeper"
+        for r in client.list_inconsistent_obj(pgid)
+    ), "shallow scrub wiped a deep finding it cannot re-test"
+    assert "OSD_SCRUB_ERRORS" in _health(client)["checks_detail"]
+    client.pg_repair(pgid)
+    assert wait_for(
+        lambda: not any(
+            r["object"]["name"] == "keeper"
+            for r in client.list_inconsistent_obj(pgid)
+        ),
+        20.0,
+    )
+    assert io.read("keeper") == payload
+
+
+def test_ceph_cli_pg_scrub_dispatch(cluster, client, capsys):
+    """`ceph pg deep-scrub <pgid>`: the mon names the primary, the
+    CLI dispatches the order there and prints its ack."""
+    from ceph_tpu.tools.ceph_cli import _build_command, main
+
+    assert _build_command(["pg", "deep-scrub", "1.0"]) == {
+        "prefix": "pg deep-scrub", "pgid": "1.0",
+    }
+    assert _build_command(["pg", "repair", "2.1"]) == {
+        "prefix": "pg repair", "pgid": "2.1",
+    }
+    io = client.open_ioctx("rp")
+    io.write_full("cliobj", b"cli bytes")
+    pgid = _pgid_of(client, "rp", "cliobj")
+    rc = main(
+        [
+            "-m", f"{cluster.mon_addr[0]}:{cluster.mon_addr[1]}",
+            "pg", "deep-scrub", pgid,
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "deep-scrub" in out and pgid in out
+    # a pg that does not exist is rejected by the mon
+    rc = main(
+        [
+            "-m", f"{cluster.mon_addr[0]}:{cluster.mon_addr[1]}",
+            "pg", "scrub", "1.9999",
+        ]
+    )
+    capsys.readouterr()
+    assert rc != 0
+
+
+def test_clog_carries_scrub_events(cluster, client):
+    """Scrub start/end events land on the PR-2 cluster log."""
+    rc, outb, outs = client.mon_command(
+        {"prefix": "log last", "num": 200}
+    )
+    assert rc == 0, outs
+    lines = json.loads(outb)
+    msgs = [e["message"] for e in lines]
+    assert any("deep-scrub starts" in m for m in msgs), msgs[-10:]
+    assert any(
+        ("deep-scrub" in m and "errors" in m) or "repair" in m
+        for m in msgs
+    ), msgs[-10:]

@@ -47,6 +47,21 @@ CLOG_MAX_NAME = 64
 _LABEL_SAFE_RE = re.compile(r"^[\x20-\x7e]*$")
 _CHANNEL_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9_.-]*$")
 
+# -- scrub-plane schema bounds ----------------------------------------------
+# inconsistency records (osd/scrub.py make_record, the rados
+# list-inconsistent-obj shape served by the primary's ScrubStore)
+INCONSISTENT_REQUIRED = (
+    "object", "errors", "union_shard_errors", "shards", "oid",
+)
+INCONSISTENT_MAX_SHARDS = 64
+INCONSISTENT_MAX_NAME = 1024
+# scrub counters the OSD schema must declare (the mgr exporter's
+# ceph_osd_scrub_* families read exactly these)
+SCRUB_COUNTERS = (
+    "scrub_errors", "scrubs_active", "scrub_chunks",
+    "scrub_deep_bytes", "scrub_last_age",
+)
+
 CRASH_REQUIRED = (
     "crash_id", "entity_name", "timestamp", "timestamp_iso",
     "exception", "backtrace", "dout_tail", "meta",
@@ -141,6 +156,122 @@ def check_crash_report(report) -> list[str]:
     return errors
 
 
+def check_inconsistent_record(rec) -> list[str]:
+    """Lint one inconsistency record (ScrubStore / MScrubCommand
+    list-inconsistent-obj shape)."""
+    from ceph_tpu.osd.scrub import KNOWN_ERRORS
+
+    errors: list[str] = []
+    if not isinstance(rec, dict):
+        return ["inconsistent record: not a dict"]
+    for field in INCONSISTENT_REQUIRED:
+        if field not in rec:
+            errors.append(
+                f"inconsistent record: missing field {field!r}"
+            )
+    obj = rec.get("object")
+    if not isinstance(obj, dict) or not isinstance(
+        obj.get("name"), str
+    ):
+        errors.append(
+            "inconsistent record: object.name missing or non-str"
+        )
+    elif len(obj["name"]) > INCONSISTENT_MAX_NAME or not (
+        _LABEL_SAFE_RE.match(obj["name"])
+    ):
+        errors.append(
+            f"inconsistent record: object name {obj['name']!r} "
+            "unbounded or not label-safe"
+        )
+    for key in ("errors", "union_shard_errors"):
+        vocab = rec.get(key, [])
+        if not isinstance(vocab, list):
+            errors.append(f"inconsistent record: {key} not a list")
+            continue
+        for e in vocab:
+            if e not in KNOWN_ERRORS:
+                errors.append(
+                    f"inconsistent record: unknown error code {e!r}"
+                )
+    shards = rec.get("shards", [])
+    if not isinstance(shards, list):
+        errors.append("inconsistent record: shards not a list")
+        shards = []
+    if len(shards) > INCONSISTENT_MAX_SHARDS:
+        errors.append(
+            f"inconsistent record: over {INCONSISTENT_MAX_SHARDS} "
+            "shards"
+        )
+    for sh in shards:
+        if not isinstance(sh, dict) or not isinstance(
+            sh.get("osd"), int
+        ):
+            errors.append(
+                "inconsistent record: shard entry without int osd"
+            )
+            continue
+        for e in sh.get("errors", []):
+            if e not in KNOWN_ERRORS:
+                errors.append(
+                    f"inconsistent record: shard {sh['osd']} unknown "
+                    f"error code {e!r}"
+                )
+    return errors
+
+
+def product_scrub_samples() -> list[str]:
+    """Run the REAL compare paths over synthetic scrub maps and lint
+    the records they produce — the shapes ScrubStore persists and
+    list-inconsistent-obj serves."""
+    from ceph_tpu.osd.scrub import compare_ec, compare_replicated
+
+    errors: list[str] = []
+    base = {
+        "exists": True, "size": 11, "omap_digest": 1,
+        "attrs_digest": 2, "data_digest": 3,
+    }
+    rec = compare_replicated(
+        "o_probe",
+        {0: dict(base), 1: dict(base), 2: dict(base, data_digest=9)},
+        primary=0,
+        deep=True,
+    )
+    if rec is None:
+        errors.append("compare_replicated: planted mismatch unfound")
+    else:
+        errors.extend(check_inconsistent_record(rec))
+    ec_ent = {
+        "exists": True, "size": 8, "omap_digest": 1,
+        "attrs_digest": 2, "data_digest": 3,
+        "hinfo": {"size": 16, "hashes": [3, 3, 9]},
+    }
+    rec, _needs = compare_ec(
+        "o_probe",
+        {0: dict(ec_ent), 1: dict(ec_ent), 2: dict(ec_ent)},
+        acting=[0, 1, 2],
+        sinfo=None,
+        deep=True,
+    )
+    if rec is None:
+        errors.append("compare_ec: planted shard mismatch unfound")
+    else:
+        errors.extend(check_inconsistent_record(rec))
+    return errors
+
+
+def check_scrub_counters() -> list[str]:
+    """The OSD schema must keep declaring the scrub counter block the
+    exporter's ceph_osd_scrub_* families are built from."""
+    from ceph_tpu.osd.daemon import build_osd_perf
+
+    declared = set(build_osd_perf(0)._counters)
+    return [
+        f"osd schema: scrub counter {name!r} missing"
+        for name in SCRUB_COUNTERS
+        if name not in declared
+    ]
+
+
 def product_event_samples() -> list[str]:
     """Generate one real clog entry and one real crash report through
     the product code paths and lint them — the schemas daemons
@@ -225,8 +356,10 @@ def check_all(sets=None) -> list[str]:
             cross.add(key)
     if lint_events:
         # product mode (no explicit sets): also lint the event-plane
-        # schemas the daemons really emit
+        # and scrub-plane schemas the daemons really emit
         errors.extend(product_event_samples())
+        errors.extend(product_scrub_samples())
+        errors.extend(check_scrub_counters())
     return errors
 
 
