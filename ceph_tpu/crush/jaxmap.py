@@ -2001,6 +2001,20 @@ def _spec_boost_for(weights) -> int:
     return 1 if frac > 0.02 else 0
 
 
+def batched_rule_call(cm: CompiledMap, ruleno: int, result_max: int,
+                      weights):
+    """The jitted batched kernel plus its packed table operands —
+    the dispatch seam mesh-sharded callers (osd/sharded_mapping.py)
+    go through so they never re-implement table packing or the
+    speculation-boost selection.  Returns ``(fn, tables)``; call as
+    ``fn(xs_dev, weight_vector, *tables)`` with ``xs_dev`` placed
+    under any sharding (the kernel is lane-independent) and get the
+    raw ``(res, counts, ok)`` device arrays back — finalize with
+    :func:`apply_oracle_fallback`."""
+    fn = _batched(cm, ruleno, result_max, _spec_boost_for(weights))
+    return fn, _kernel_tables(cm)
+
+
 def batch_do_rule(
     cm: CompiledMap,
     ruleno: int,

@@ -110,6 +110,14 @@ class MDSDaemon(Dispatcher):
         self._applied_table_epoch = 0
         self._peer_addrs: dict[int, str] = {}
         self._peer_conns: dict[int, Connection] = {}
+        # shrink adoption (mon stray_ranks protocol): (rank, gen)
+        # pairs whose journals WE replayed; acked on the next beacon
+        # so the mon drains its queue and lets the re-pinned table
+        # stabilize.  The generation tag pins each ack to ONE
+        # eviction: a stale ack can never drain a newer eviction of
+        # the same rank whose journal we have not replayed yet
+        self._adopted_ranks: set[tuple[int, int]] = set()
+        self.adopted_entries = 0  # observability/test hook
 
         # metadata cache (MDCache role): dirfrags + inodes, loaded
         # lazily from the backing omap, mutated ahead of lazy flushes
@@ -168,20 +176,23 @@ class MDSDaemon(Dispatcher):
     def _beacon_loop(self) -> None:
         while not self._stop.is_set():
             try:
-                rc, outb, _outs = self.rados.mon_command(
-                    {
-                        "prefix": "mds beacon",
-                        "name": self.name,
-                        "addr": self.addr,
-                        "state": self.state,
-                        # the mon fences THIS id if it replaces us
-                        # while we are partitioned (_fence_mds)
-                        "client": self.rados.client_id,
-                        # ack: the subtree table epoch we have
-                        # FLUSHED under (the export barrier)
-                        "table_epoch": self._applied_table_epoch,
-                    }
-                )
+                beacon = {
+                    "prefix": "mds beacon",
+                    "name": self.name,
+                    "addr": self.addr,
+                    "state": self.state,
+                    # the mon fences THIS id if it replaces us
+                    # while we are partitioned (_fence_mds)
+                    "client": self.rados.client_id,
+                    # ack: the subtree table epoch we have
+                    # FLUSHED under (the export barrier)
+                    "table_epoch": self._applied_table_epoch,
+                }
+                if self._adopted_ranks:
+                    beacon["adopted_ranks"] = sorted(
+                        list(e) for e in self._adopted_ranks
+                    )
+                rc, outb, _outs = self.rados.mon_command(beacon)
                 if rc == 0 and outb:
                     told = json.loads(outb)
                     self.mdsmap_epoch = told.get("epoch", 0)
@@ -193,6 +204,17 @@ class MDSDaemon(Dispatcher):
                     new_table = told.get("subtrees")
                     new_te = told.get("table_epoch", 0)
                     new_rank = told.get("rank", 0)
+                    if self._adopted_ranks:
+                        # the mon drained acked ranks from its stray
+                        # queue: forget them, so a rank evicted AGAIN
+                        # after a re-grow is re-adopted, not skipped
+                        # (the gen tag already guarantees that; this
+                        # just bounds the ack set)
+                        still = {
+                            (int(e[0]), int(e[1]))
+                            for e in told.get("adopt_ranks", [])
+                        }
+                        self._adopted_ranks &= still
                     if want == "active" and (
                         self.state != "active"
                         or new_rank != self.rank
@@ -207,6 +229,26 @@ class MDSDaemon(Dispatcher):
                         self._subtrees = dict(new_table or {"/": 0})
                         self._applied_table_epoch = new_te
                         self._become_active(new_rank)
+                        if told.get("adopt_ranks"):
+                            self._adopt_stray_ranks(
+                                told["adopt_ranks"]
+                            )
+                    elif (
+                        want == "active"
+                        and told.get("adopt_ranks")
+                    ):
+                        # shrink adoption BEFORE acking the re-pinned
+                        # table: the evicted rank's client-acked
+                        # mutations must be in OUR cache/omap before
+                        # clients route its subtrees here
+                        self._adopt_stray_ranks(told["adopt_ranks"])
+                        if (
+                            new_table is not None
+                            and new_te > self._applied_table_epoch
+                        ):
+                            self._apply_subtree_table(
+                                new_table, new_te
+                            )
                     elif (
                         want == "active"
                         and new_table is not None
@@ -279,6 +321,79 @@ class MDSDaemon(Dispatcher):
                 self._revoke(ino, None)
             self._subtrees = dict(table)
             self._applied_table_epoch = te
+
+    def _adopt_stray_ranks(self, ranks) -> None:
+        """Shrink adoption (``mds set-max-mds``): an evicted rank was
+        FENCED mid-life, so its client-acked but unflushed mutations
+        exist only in its per-rank journal.  Replay that journal into
+        OUR cache (the ``mds fail`` takeover walk, but into the
+        re-pin target instead of a promoted standby), flush to the
+        backing omap, and trim the stray stream so a later re-grow
+        promotion replays nothing stale.  ``ranks`` holds the mon's
+        ``[rank, gen]`` queue entries; acked pair-for-pair on the
+        next beacon (``adopted_ranks``) so the mon drains its queue
+        and lets the re-pinned table stabilize for clients."""
+        with self._lock:
+            for rank, gen in sorted(
+                (int(e[0]), int(e[1])) for e in ranks
+            ):
+                if (
+                    (rank, gen) in self._adopted_ranks
+                    or rank == self.rank
+                ):
+                    continue
+                j = Journaler(
+                    self.meta,
+                    prefix=(
+                        "mds_journal" if rank == 0
+                        else f"mds_journal.{rank}"
+                    ),
+                )
+                j.load()
+                adopted = 0
+                # allocations in the stray journal must advance the
+                # EVICTED rank's persisted ino counter, not ours: a
+                # re-grown rank resumes allocating from its own key,
+                # and our counter must never jump into a foreign
+                # range (disjoint per-rank ino spaces)
+                saved_next = self._next_ino
+                stray_max = -1
+                for blob in j.replay():
+                    ent = json.loads(blob)
+                    self._apply_entry(ent)
+                    if ent["op"] in ("mkdir", "create"):
+                        ino = int(ent["ino"])
+                        if (ino >> 40) == rank:
+                            stray_max = max(stray_max, ino)
+                    adopted += 1
+                self._next_ino = max(
+                    [saved_next]
+                    + [
+                        i + 1
+                        for i in self._inodes
+                        if self._my_ino(i)
+                    ]
+                )
+                self._flush()
+                if stray_max >= 0:
+                    key = f"next_ino.{rank}"
+                    stored = int(
+                        self._ino_meta(ROOT_INO).get(
+                            key, (rank << 40) + 2
+                        )
+                    )
+                    nxt = max(stored, stray_max + 1)
+                    self.meta.omap_set(
+                        _ino_oid(ROOT_INO), {key: str(nxt).encode()}
+                    )
+                    self._inodes[ROOT_INO][key] = nxt
+                j.trim()
+                self._adopted_ranks.add((rank, gen))
+                self.adopted_entries += adopted
+                self.clog.info(
+                    f"mds.{self.name} adopted rank {rank}'s journal "
+                    f"({adopted} entries) after shrink"
+                )
 
     # -- backing store (the ceph_tpu.fs omap layout) -----------------------
     def _mkfs_if_needed(self) -> None:

@@ -1275,15 +1275,30 @@ def _mdsmap_of(mon: Monitor) -> dict:
             "subtrees_stable": {"/": 0},
             "table_epoch": 0,
             "table_acks": {},  # name -> acked table_epoch
+            # shrink-evicted ranks whose journals rank 0 must adopt
+            # (replay + trim) before the re-pinned table stabilizes;
+            # entries are [rank, gen] — the generation tag makes an
+            # ack specific to ONE eviction, so a stale beacon ack
+            # from before a re-grow→re-shrink cycle cannot drain a
+            # NEWER eviction's un-replayed journal
+            "stray_ranks": [],
+            "stray_gen": 0,
         }
     return m
 
 
 def _mds_promote_holes(mon: Monitor, m: dict) -> None:
-    """Fill empty ranks (0..max_mds-1) from the standby pool."""
+    """Fill empty ranks (0..max_mds-1) from the standby pool.  A rank
+    whose shrink-evicted journal is still queued for adoption
+    (stray_ranks) is NOT refilled yet: promoting it mid-adoption
+    would let the adopter's eventual trim() write a stale journal
+    head over entries the fresh rank has already flushed — the rank
+    re-grows only after its journal drained (rank 0 is never evicted,
+    so adoption always makes progress)."""
+    queued = {e[0] for e in m.get("stray_ranks", [])}
     for rank in range(m["max_mds"]):
         key = str(rank)
-        if key in m["actives"]:
+        if key in m["actives"] or rank in queued:
             continue
         if not m["standbys"]:
             break
@@ -1294,9 +1309,15 @@ def _mds_promote_holes(mon: Monitor, m: dict) -> None:
 def _mds_table_maybe_stabilize(m: dict) -> None:
     """Expose the latest subtree table to clients once EVERY active
     has flushed under it (two-phase export: the old auth's dirty
-    state must reach the backing omap before the new auth serves)."""
+    state must reach the backing omap before the new auth serves).
+    Undrained stray journals (a shrink's evicted ranks, adopted by
+    rank 0 — see _cmd_mds_set_max) hold the table back too: clients
+    must not route to the new auth before it replayed the evicted
+    rank's client-acked mutations."""
     te = m["table_epoch"]
     if m["subtrees_stable"] == m["subtrees"]:
+        return
+    if m.get("stray_ranks"):
         return
     if all(
         m["table_acks"].get(e["name"], -1) >= te
@@ -1317,6 +1338,16 @@ def _cmd_mds_beacon(mon: Monitor, cmd: dict) -> MMonCommandReply:
     m = _mdsmap_of(mon)
     now = time.time()
     m["beacons"][name] = now
+    if cmd.get("adopted_ranks") and m.get("stray_ranks"):
+        # rank 0 replayed these evicted ranks' journals (shrink
+        # adoption, _cmd_mds_set_max): drain the queue so the
+        # re-pinned table can stabilize.  Acks are (rank, gen) pairs
+        # — an ack for an OLDER eviction of the same rank does not
+        # drain a newer one still awaiting replay
+        done = {(int(e[0]), int(e[1])) for e in cmd["adopted_ranks"]}
+        m["stray_ranks"] = [
+            e for e in m["stray_ranks"] if tuple(e) not in done
+        ]
     if "table_epoch" in cmd:
         m["table_acks"][name] = int(cmd["table_epoch"])
         _mds_table_maybe_stabilize(m)
@@ -1346,6 +1377,20 @@ def _cmd_mds_beacon(mon: Monitor, cmd: dict) -> MMonCommandReply:
         if m["actives"][str(my_rank)]["addr"] != addr:
             m["epoch"] += 1
         m["actives"][str(my_rank)] = entry
+    elif entry["client"] and mon.osdmap.is_blocklisted(
+        entry["client"]
+    ):
+        # a shrink/fail-evicted daemon still beaconing under its
+        # FENCED identity must not become promotion-eligible:
+        # parking it in standbys could re-promote it in this very
+        # call (_mds_promote_holes below) while every rados op it
+        # issues raises -EBLOCKLISTED — a wedged active that never
+        # drains stray_ranks.  Keep it out; the standby reply makes
+        # the daemon shed the identity (new_identity) and its next
+        # beacon registers a fresh, unfenced standby.
+        m["standbys"] = [
+            s for s in m["standbys"] if s["name"] != name
+        ]
     else:
         if all(s["name"] != name for s in m["standbys"]):
             m["standbys"].append(entry)
@@ -1364,35 +1409,56 @@ def _cmd_mds_beacon(mon: Monitor, cmd: dict) -> MMonCommandReply:
         ),
         None,
     )
-    return MMonCommandReply(
-        rc=0,
-        outb=json.dumps({
-            "state": "active" if my_rank is not None else "standby",
-            "rank": -1 if my_rank is None else my_rank,
-            "epoch": m["epoch"],
-            "subtrees": m["subtrees"],
-            "table_epoch": m["table_epoch"],
-            "actives": {
-                r: e["addr"] for r, e in m["actives"].items()
-            },
-        }),
-    )
+    payload = {
+        "state": "active" if my_rank is not None else "standby",
+        "rank": -1 if my_rank is None else my_rank,
+        "epoch": m["epoch"],
+        "subtrees": m["subtrees"],
+        "table_epoch": m["table_epoch"],
+        "actives": {
+            r: e["addr"] for r, e in m["actives"].items()
+        },
+    }
+    if my_rank == 0 and m.get("stray_ranks"):
+        # the shrink re-pin target: adopt these evicted ranks'
+        # journals before serving their subtrees
+        payload["adopt_ranks"] = sorted(m["stray_ranks"])
+    return MMonCommandReply(rc=0, outb=json.dumps(payload))
 
 
 def _cmd_mds_set_max(mon: Monitor, cmd: dict) -> MMonCommandReply:
     """'mds set-max-mds' (fs set max_mds): grow/shrink the active
     rank count; standbys promote into new ranks on their next
-    beacons.  Shrinking evicts the highest ranks (their subtrees
-    re-pin to 0)."""
+    beacons.  Shrinking evicts the highest ranks exactly like
+    ``mds fail`` does: the evicted daemon's client id is FENCED (a
+    partitioned-but-alive rank must not flush stale state later), its
+    subtrees re-pin to 0, and its rank joins ``stray_ranks`` — the
+    journal-adoption queue rank 0 drains (replaying the evicted
+    rank's unflushed, client-acked mutations) before the re-pinned
+    table stabilizes for clients.  The evicted daemon re-registers as
+    a standby via its next beacon, shedding the fenced identity on
+    the way (mds/server.py demotion path)."""
     m = _mdsmap_of(mon)
     n = int(cmd["max_mds"])
     if n < 1:
         return MMonCommandReply(rc=-22, outs="max_mds >= 1 (-EINVAL)")
+    strays = m.setdefault("stray_ranks", [])
+    # a grow does NOT drop queued strays: _mds_promote_holes holds
+    # the re-grown rank back until its journal adoption drains, so a
+    # fresh promotee never races the adopter's replay+trim
     m["max_mds"] = n
     for rank in [r for r in m["actives"] if int(r) >= n]:
         gone = m["actives"].pop(rank)
-        m["standbys"].append(gone)
+        _fence_mds(mon, gone)
+        m["beacons"].pop(gone["name"], None)
         m["table_acks"].pop(gone["name"], None)
+        # one queue entry per rank (promotion is blocked while
+        # queued, so the same rank cannot be evicted twice into the
+        # queue — the filter is belt-and-suspenders), tagged with a
+        # fresh generation so only an ack for THIS eviction drains it
+        gen = m["stray_gen"] = m.get("stray_gen", 0) + 1
+        strays[:] = [e for e in strays if e[0] != int(rank)]
+        strays.append([int(rank), gen])
     changed = False
     for p, r in list(m["subtrees"].items()):
         if r >= n:

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ec.backend import register_backend
-from . import packed_gf
+from . import mesh, packed_gf
 from .gf_matmul import (
     bitmatrix_packet_regions,
     gf_matrix_regions,
@@ -118,6 +118,19 @@ class JaxBackend:
         with kernel_stats().timed(
             "gf_matmul", bytes_in=stripes.nbytes
         ) as kt:
+            # batch axis sharded across the device mesh when >1 device
+            # exists and the batch is worth splitting — byte-identical
+            # per-stripe math, just spread over chips (ops/mesh.py).
+            # Checked BEFORE the packed fast path: N chips of bitplane
+            # (~75 GB/s each) beat one chip of packed (~130 GB/s) for
+            # every N >= 2; the packed kernel folds the batch into its
+            # byte axis, so sharding it is future work
+            dmesh = mesh.default_mesh()
+            if dmesh is not None and b >= dmesh.n:
+                bm = matrix_to_device_bitmatrix(matrix, w)
+                out = mesh.sharded_matrix_stripes(bm, stripes, w, dmesh)
+                kt.bytes_out = out.nbytes
+                return out
             if w == 8 and _on_tpu() and (b * chunk) % 4 == 0:
                 bm_np, ok = _host_bm(matrix, w)
                 if ok:

@@ -164,9 +164,12 @@ class OSDMapMapping:
         if use_device:
             try:
                 from ..crush import jaxmap
+                from .sharded_mapping import mesh_batch_do_rule
 
                 cm = _compiled(osdmap.crush)
-                res, counts = jaxmap.batch_do_rule(
+                # shards across the device mesh when >1 device exists
+                # (ParallelPGMapper role); single-device unchanged
+                res, counts = mesh_batch_do_rule(
                     cm, ruleno, pps, pool.size, osdmap.osd_weight
                 )
                 raw = np.asarray(res, dtype=np.int64)

@@ -103,17 +103,21 @@ def parse_args(argv=None):
     p.add_argument("--show-bad-mappings", action="store_true")
     p.add_argument("--weight", type=str, action="append", default=[],
                    metavar="OSD:W", help="reweight osd, e.g. 3:0.5")
+    p.add_argument("--compare", metavar="MAP2.BIN",
+                   help="mapping-delta report vs a second binary map "
+                        "over the --test x range (crushtool.cc:231)")
+    p.add_argument("--tree", action="store_true",
+                   help="print the bucket hierarchy as a tree")
     args = p.parse_args(argv)
-    if not (args.test or args.compile or args.decompile or args.input):
-        p.error("no action specified (use -c, -d, -i and/or --test)")
+    if not (args.test or args.compile or args.decompile or args.input
+            or args.compare or args.tree):
+        p.error("no action specified (use -c, -d, -i, --test, "
+                "--compare and/or --tree)")
     return args
 
 
-def run_test(m: CrushMap, args) -> dict:
-    n = args.max_x - args.min_x
-    xs = np.arange(args.min_x, args.max_x, dtype=np.int64)
-    num_osds = m.max_devices
-    weights = [0x10000] * num_osds
+def _parse_weights(m: CrushMap, args) -> list[int]:
+    weights = [0x10000] * m.max_devices
     for spec in args.weight:
         osd, sep, w = spec.partition(":")
         if not sep:
@@ -126,7 +130,16 @@ def run_test(m: CrushMap, args) -> dict:
             # weight map (crushtool.cc:822); they can't match anyway
             weights.extend([0x10000] * (osd + 1 - len(weights)))
         weights[osd] = int(float(w) * 0x10000)
+    return weights
 
+
+def _map_range(m: CrushMap, args, weights, timed: bool = True):
+    """Map x ∈ [min-x, max-x) through ``--rule`` on the selected
+    backend.  Returns (res, counts, elapsed, backend) with ``elapsed``
+    from a compile-free pass (the throughput figure).  ``timed=False``
+    skips that second pass for callers that discard elapsed
+    (--compare maps both inputs; no point doubling the device work)."""
+    xs = np.arange(args.min_x, args.max_x, dtype=np.int64)
     t0 = time.perf_counter()
     backend = args.backend
     if backend == "jax":
@@ -144,13 +157,15 @@ def run_test(m: CrushMap, args) -> dict:
         )
         res = np.asarray(res)
         counts = np.asarray(counts)
-        # time a second, compile-free pass for the throughput figure
-        t0 = time.perf_counter()
-        res2, _ = jaxmap.batch_do_rule(
-            cm, args.rule, xs, args.num_rep, weights
-        )
-        np.asarray(res2)
         elapsed = time.perf_counter() - t0
+        if timed:
+            # time a second, compile-free pass for the throughput figure
+            t0 = time.perf_counter()
+            res2, _ = jaxmap.batch_do_rule(
+                cm, args.rule, xs, args.num_rep, weights
+            )
+            np.asarray(res2)
+            elapsed = time.perf_counter() - t0
     else:
         rows = []
         counts = []
@@ -161,6 +176,14 @@ def run_test(m: CrushMap, args) -> dict:
         res = np.asarray(rows, dtype=np.int64)
         counts = np.asarray(counts)
         elapsed = time.perf_counter() - t0
+    return res, counts, elapsed, backend
+
+
+def run_test(m: CrushMap, args) -> dict:
+    n = args.max_x - args.min_x
+    num_osds = m.max_devices
+    weights = _parse_weights(m, args)
+    res, counts, elapsed, backend = _map_range(m, args, weights)
     args.backend = backend  # report the backend that actually ran
 
     valid = (res != CRUSH_ITEM_NONE) & (
@@ -188,6 +211,96 @@ def run_test(m: CrushMap, args) -> dict:
     }
 
 
+def run_compare(m1: CrushMap, m2: CrushMap, args) -> dict:
+    """Mapping-delta report between two maps (crushtool.cc:231
+    --compare, the balancer-validation workflow): map the same x
+    range through ``--rule`` on BOTH maps and count changed mappings
+    — whole-x changes (any position differs) and moved slots (data
+    that would migrate).  Output is deterministic for a given
+    (maps, range, rule, weights): stable field order, fixed float
+    formatting — so workflows can diff it (dencoder-stable)."""
+    n = args.max_x - args.min_x
+    w1 = _parse_weights(m1, args)
+    w2 = _parse_weights(m2, args)
+    res1, counts1, _, b1 = _map_range(m1, args, w1, timed=False)
+    res2, counts2, _, b2 = _map_range(m2, args, w2, timed=False)
+    args.backend = b1 if b1 == b2 else "mixed"
+    row_changed = (res1 != res2).any(axis=1) | (counts1 != counts2)
+    valid = (res1 != CRUSH_ITEM_NONE) & (
+        np.arange(args.num_rep)[None, :] < counts1[:, None]
+    )
+    slots = int(valid.sum())
+    moved = int((valid & (res1 != res2)).sum())
+    changed = int(row_changed.sum())
+    return {
+        "n": n,
+        "changed": changed,
+        "changed_ratio": changed / n if n else 0.0,
+        "slots": slots,
+        "moved": moved,
+        "moved_ratio": moved / slots if slots else 0.0,
+        "equivalent": changed == 0,
+    }
+
+
+def format_compare(stats: dict, args) -> str:
+    lines = [
+        (
+            f"rule {args.rule} x [{args.min_x},{args.max_x}) num_rep "
+            f"{args.num_rep}: {stats['changed']}/{stats['n']} "
+            f"mappings changed "
+            f"(ratio {stats['changed_ratio']:.6f})"
+        ),
+        (
+            f"moved slots: {stats['moved']}/{stats['slots']} "
+            f"(ratio {stats['moved_ratio']:.6f})"
+        ),
+        (
+            "maps appear equivalent"
+            if stats["equivalent"]
+            else "warning: maps are NOT equivalent"
+        ),
+    ]
+    return "\n".join(lines)
+
+
+def format_tree(m: CrushMap) -> str:
+    """``crushtool --tree``-shaped hierarchy dump: one row per item,
+    roots first, children indented under their parent in bucket item
+    order.  Deterministic for a given map (stable root ordering,
+    fixed-point weights printed at 5 decimals) so the output is
+    diffable (dencoder-stable)."""
+    lines = ["ID\tWEIGHT\tTYPE NAME"]
+
+    def type_name(t: int) -> str:
+        return m.type_names.get(t, f"type{t}")
+
+    def item_name(item: int) -> str:
+        if item >= 0:
+            return f"osd.{item}"
+        return m.item_names.get(item, f"bucket{item}")
+
+    def walk(item: int, weight: int, depth: int) -> None:
+        indent = "    " * depth
+        if item >= 0:
+            lines.append(
+                f"{item}\t{weight / 0x10000:.5f}\t"
+                f"{indent}{type_name(0)} {item_name(item)}"
+            )
+            return
+        b = m.buckets[item]
+        lines.append(
+            f"{item}\t{b.weight / 0x10000:.5f}\t"
+            f"{indent}{type_name(b.type)} {item_name(item)}"
+        )
+        for child, w in zip(b.items, b.item_weights):
+            walk(child, w, depth + 1)
+
+    for root in sorted(m._roots()):
+        walk(root, m.buckets[root].weight, 0)
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     from ..crush import compiler
@@ -208,7 +321,10 @@ def main(argv=None) -> int:
                 f.write(text)
         else:
             sys.stdout.write(text)
-        return 0
+        # -d composes with --tree/--compare/--test on the decoded map
+        # (parse_args advertises "and/or"); plain -d is done here
+        if not (args.tree or args.compare or args.test):
+            return 0
     elif args.input:
         with open(args.input, "rb") as f:
             m = compiler.decode_crushmap(f.read())
@@ -217,8 +333,19 @@ def main(argv=None) -> int:
         num_osds, per_host = parts[0], parts[1]
         hpr = parts[2] if len(parts) > 2 else 0
         m = build_hierarchy(num_osds, per_host, hpr)
+    if args.tree:
+        print(format_tree(m))
+    rc = 0
+    if args.compare:
+        with open(args.compare, "rb") as f:
+            m2 = compiler.decode_crushmap(f.read())
+        stats = run_compare(m, m2, args)
+        print(format_compare(stats, args))
+        # non-equivalence is the exit status even when --test also
+        # runs below (the flags compose "and/or", parse_args)
+        rc = 0 if stats["equivalent"] else 1
     if not args.test:
-        return 0
+        return rc
     stats = run_test(m, args)
     print(
         f"rule {args.rule} x [{args.min_x},{args.max_x}) num_rep "
@@ -236,7 +363,7 @@ def main(argv=None) -> int:
             f"chi-squared = {stats['chi2']:.2f} "
             f"(expected per device {stats['expected']:.1f})"
         )
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

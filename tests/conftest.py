@@ -13,6 +13,16 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Product paths shard across the default mesh whenever >1 device
+# exists (ops/mesh.py).  On this VIRTUAL 8-device mesh that would
+# recompile a sharded program for every unique shape the suite
+# touches, ballooning wall-clock far past the tier-1 budget for zero
+# coverage gain — the sharded kernels are byte-identical by
+# construction and proven so by tests/test_mesh.py, which opts back
+# in explicitly (monkeypatch).  setdefault: an external
+# CEPH_TPU_MESH=1 still forces product sharding suite-wide.
+os.environ.setdefault("CEPH_TPU_MESH", "0")
+
 # Default to the virtual CPU mesh, but honor an EXPLICIT opt-in to
 # hardware via CEPH_TPU_TEST_PLATFORM (the ambient JAX_PLATFORMS is
 # unreliable here: the launch environment pins it to its tunnel
@@ -51,3 +61,35 @@ def _isolate_global_crash_queue():
     # signature-throttle history would suppress a later test's
     # intentionally-identical crash injection
     _crash.reset_throttle()
+
+
+# Round-5 loosened several wall-clock assertions because loaded CI
+# boxes missed them; the strict bounds still catch real regressions
+# whenever the box is actually idle.  Tests pick their bound at
+# runtime: strict when the 1-minute loadavg per core is low, the
+# load-tolerant fallback otherwise.
+def _loadavg_trustworthy() -> bool:
+    """Sandboxed kernels (gVisor-class: this CI box) hardwire
+    /proc/loadavg to ``0.00 0.00 0.00 0/0 0`` — a zero TOTAL thread
+    count, impossible on real Linux, while the box may be fully
+    loaded.  Only trust loadavg when the kernel is actually
+    accounting threads; elsewhere (no /proc) os.getloadavg() is the
+    platform API and is trusted."""
+    try:
+        with open("/proc/loadavg") as f:
+            fields = f.read().split()
+        return int(fields[3].partition("/")[2]) > 0
+    except (OSError, ValueError, IndexError):
+        return True  # no /proc: nothing contradicts getloadavg
+
+
+def strict_timing() -> bool:
+    """True when this box is PROVABLY idle enough for strict timing
+    bounds; unmeasurable load keeps the load-tolerant bound."""
+    if not _loadavg_trustworthy():
+        return False
+    try:
+        load = os.getloadavg()[0]
+    except OSError:  # platform without getloadavg
+        return False
+    return load / (os.cpu_count() or 1) < 0.5

@@ -272,3 +272,58 @@ def test_crushtool_cli_weight_robustness(tmp_path):
               "--backend", "oracle"])
     with pytest.raises(SystemExit):
         main([])  # no action
+
+
+def test_crushtool_tree_output_stable(capsys):
+    """--tree: hierarchy dump, dencoder-stable (identical runs emit
+    identical bytes; roots sorted, children in bucket item order)."""
+    from ceph_tpu.tools.crushtool import main
+
+    assert main(["--build", "8:4", "--tree"]) == 0
+    first = capsys.readouterr().out
+    assert main(["--build", "8:4", "--tree"]) == 0
+    assert capsys.readouterr().out == first
+    lines = first.splitlines()
+    assert lines[0] == "ID\tWEIGHT\tTYPE NAME"
+    assert any("root default" in ln for ln in lines)
+    assert any("host host0" in ln for ln in lines)
+    assert sum("osd osd." in ln for ln in lines) == 8
+    # weights are 16.16 fixed rendered at 5 decimals
+    root = next(ln for ln in lines if "root default" in ln)
+    assert root.split("\t")[1] == "8.00000"
+
+
+def test_crushtool_compare_delta_and_equivalence(tmp_path, capsys):
+    """--compare: the mapping-delta report between two maps through
+    the --test machinery (crushtool.cc:231, the balancer-validation
+    workflow).  Identical maps -> equivalent, rc 0; a reweighted map
+    -> a non-zero delta, rc 1; output is deterministic."""
+    from ceph_tpu.crush import compiler
+    from ceph_tpu.tools.crushtool import build_hierarchy, main
+
+    m1 = build_hierarchy(16, 4, 2)
+    m2 = build_hierarchy(
+        16, 4, 2,
+        weight_fn=lambda o: 0x8000 if o == 0 else 0x10000,
+    )
+    p1 = tmp_path / "a.bin"
+    p2 = tmp_path / "b.bin"
+    p1.write_bytes(compiler.encode_crushmap(m1))
+    p2.write_bytes(compiler.encode_crushmap(m2))
+
+    base = ["--max-x", "256", "--backend", "oracle"]
+    assert main(["-i", str(p1), "--compare", str(p1)] + base) == 0
+    same = capsys.readouterr().out
+    assert "0/256 mappings changed" in same
+    assert "maps appear equivalent" in same
+
+    assert main(["-i", str(p1), "--compare", str(p2)] + base) == 1
+    diff = capsys.readouterr().out
+    assert "maps are NOT equivalent" in diff
+    changed = int(
+        diff.splitlines()[0].split(":")[1].strip().split("/")[0]
+    )
+    assert changed > 0
+    # dencoder-stable: a second run emits identical bytes
+    assert main(["-i", str(p1), "--compare", str(p2)] + base) == 1
+    assert capsys.readouterr().out == diff

@@ -218,6 +218,11 @@ def test_bad_command_returns_error_not_timeout(cluster):
         {"prefix": "osd reweight", "id": 999, "weight": 0.5}
     )
     assert r.rc == -22
-    assert time.monotonic() - t0 < 5  # no 30s hang
+    # a command round trip is milliseconds on an idle box — strict
+    # there, load-tolerant 5s on busy CI (round-5 flake class);
+    # either way far under the 30s hang this guards against
+    from conftest import strict_timing
+
+    assert time.monotonic() - t0 < (1.5 if strict_timing() else 5)
     assert mon.osdmap.epoch == epoch  # nothing applied
     assert mon.store.last_committed() == epoch

@@ -30,9 +30,11 @@ def _stable_table(cluster) -> dict:
     return json.loads(outb)["subtrees"]
 
 
-def _wait_stable(cluster, path: str, rank: int, timeout=15.0) -> None:
+def _wait_stable(cluster, path: str, rank: int, timeout=30.0) -> None:
     """Wait for the two-phase table flip: the mon exposes the new
-    table to clients only after every active flushed and acked."""
+    table to clients only after every active flushed and acked.
+    Liveness wait, not a perf bound — sized for a CI box that stalls
+    whole seconds at a time."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if _stable_table(cluster).get(path) == rank:
@@ -160,3 +162,118 @@ def test_kill_either_active_rehomes_its_rank(cluster):
     fs2 = cluster.client("mm-ha3")
     fs2.create("/a/after-failover")
     assert "after-failover" in fresh.readdir("/a")
+
+
+def test_shrink_fences_adopts_journal_and_regrows():
+    """``mds set-max-mds`` shrink must behave like ``mds fail`` for
+    the evicted rank: its client id is FENCED (a live-but-evicted
+    daemon cannot flush stale state later), rank 0 ADOPTS its journal
+    (replaying client-acked, unflushed mutations) before the
+    re-pinned table stabilizes for clients, and a later re-grow
+    serves the same namespace with fresh allocations intact."""
+    c = FSCluster()
+    try:
+        rc, _outb, outs = c.rados.mon_command(
+            {"prefix": "mds set-max-mds", "max_mds": 2}
+        )
+        assert rc == 0, outs
+        c.start_mds("s0", flush_every=10_000)
+        c.start_mds("s1", flush_every=10_000)
+        c.wait_active("s0")
+        c.wait_active("s1")
+        fs = c.client("shrink")
+        fs.mkdir("/a")
+        fs.mkdir("/b")
+        _pin(c, "/a", 1)
+        _wait_stable(c, "/a", 1)
+
+        # client-ACKED but unflushed metadata on rank 1
+        # (flush_every is huge: it lives only in rank 1's journal)
+        for i in range(5):
+            fs.create(f"/a/s{i}")
+        fs.write("/a/s0", 0, b"acked")
+        rank1 = next(
+            d for d in c.mds.values()
+            if d.rank == 1 and d.state == "active"
+        )
+        rank0 = next(
+            d for d in c.mds.values()
+            if d.rank == 0 and d.state == "active"
+        )
+        fenced_id = rank1.rados.client_id
+
+        rc, _outb, outs = c.rados.mon_command(
+            {"prefix": "mds set-max-mds", "max_mds": 1}
+        )
+        assert rc == 0, outs
+
+        # the re-pin stabilizes only AFTER rank 0 adopted the
+        # evicted rank's journal (the stray_ranks barrier)
+        _wait_stable(c, "/a", 0)
+        assert rank0.adopted_entries > 0, "journal never adopted"
+        # the ack/drain cycle completed: once the mon drained its
+        # stray queue the daemon forgets the rank (so a SECOND
+        # eviction after a re-grow is re-adopted, not skipped).
+        # Polled: the mon stabilizes before rank 0's beacon thread
+        # processes the reply that clears its ack set
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and any(
+            r == 1 for r, _g in rank0._adopted_ranks
+        ):
+            time.sleep(0.05)
+        assert not any(r == 1 for r, _g in rank0._adopted_ranks)
+
+        # the evicted identity is blocklist-fenced, and the fenced id
+        # is never promotion-eligible: no standby entry may carry it
+        # (a beacon under the old identity must shed it first, or a
+        # vacant rank could re-promote a wedged, blocklisted daemon)
+        assert c.mon.osdmap.is_blocklisted(fenced_id)
+        from ceph_tpu.mon import monitor as monmod
+
+        mm = monmod._mdsmap_of(c.mon)
+        assert all(s["client"] != fenced_id for s in mm["standbys"])
+
+        # client-acked metadata survived the shrink, served by rank 0
+        fresh = c.client("shrink2")
+        names = fresh.readdir("/a")
+        for i in range(5):
+            assert f"s{i}" in names, (i, names)
+        assert fresh.read("/a/s0") == b"acked"
+
+        # the evicted daemon demotes to standby (fresh identity)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if rank1.state == "standby":
+                break
+            time.sleep(0.1)
+        assert rank1.state == "standby"
+
+        # re-grow: a standby takes rank 1 again and the namespace
+        # (including the adopted mutations) is served unchanged
+        rc, _outb, outs = c.rados.mon_command(
+            {"prefix": "mds set-max-mds", "max_mds": 2}
+        )
+        assert rc == 0, outs
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if any(
+                d.rank == 1 and d.state == "active"
+                for d in c.mds.values()
+            ):
+                break
+            time.sleep(0.1)
+        assert any(
+            d.rank == 1 and d.state == "active"
+            for d in c.mds.values()
+        ), "rank 1 never re-grew"
+        _pin(c, "/a", 1)
+        _wait_stable(c, "/a", 1)
+        fs2 = c.client("shrink3")
+        assert fs2.read("/a/s0") == b"acked"
+        names = fs2.readdir("/a")
+        for i in range(5):
+            assert f"s{i}" in names, (i, names)
+        fs2.create("/a/after-regrow")
+        assert "after-regrow" in fs2.readdir("/a")
+    finally:
+        c.shutdown()

@@ -300,7 +300,15 @@ def test_begin_fanout_pipelined_with_dead_peons():
         leader.commit(inc)
         dt = time.monotonic() - t0
         # majority = 3 = leader + 2 live peons; the two 3s timeouts
-        # must NOT serialize into the commit path
-        assert dt < 2.5, f"commit took {dt:.1f}s with 2 deaf peons"
+        # must NOT serialize into the commit path.  One RTT on an
+        # idle box is milliseconds — hold the strict bound there;
+        # the load-tolerant 2.5s stays for busy CI (round-5 flake)
+        from conftest import strict_timing
+
+        bound = 1.0 if strict_timing() else 2.5
+        assert dt < bound, (
+            f"commit took {dt:.1f}s with 2 deaf peons "
+            f"(bound {bound}s)"
+        )
     finally:
         c.shutdown()
