@@ -204,6 +204,40 @@ SCHEMA: dict[str, Option] = {
             min=1,
         ),
         Option(
+            "mon_osd_nearfull_ratio",
+            OPT_FLOAT,
+            0.85,
+            "used/total ratio above which an OSD raises OSD_NEARFULL "
+            "(mon_osd_nearfull_ratio, options.cc)",
+            min=0.0,
+            max=1.0,
+            level=LEVEL_BASIC,
+            see_also=("mon_osd_full_ratio",),
+        ),
+        Option(
+            "mon_osd_full_ratio",
+            OPT_FLOAT,
+            0.95,
+            "used/total ratio above which an OSD is FULL: writes "
+            "without FULL_TRY park on backoff and the mon raises "
+            "OSD_FULL at HEALTH_ERR (mon_osd_full_ratio)",
+            min=0.0,
+            max=1.0,
+            level=LEVEL_BASIC,
+            see_also=("mon_osd_nearfull_ratio",),
+        ),
+        Option(
+            "mon_osd_min_down_reporters",
+            OPT_INT,
+            1,
+            "distinct live reporters required before the mon accepts "
+            "a failure report — the flap guard against one partitioned "
+            "reporter re-downing a reachable OSD "
+            "(mon_osd_min_down_reporters)",
+            min=1,
+            level=LEVEL_BASIC,
+        ),
+        Option(
             "tracing_enabled",
             OPT_BOOL,
             True,

@@ -66,6 +66,9 @@ _CHANNEL_RE = _re.compile(r"^[a-zA-Z][a-zA-Z0-9_.-]{0,63}$")
 # no-TTL mutes grows the mon without bound
 MAX_HEALTH_MUTES = 64
 MAX_MUTE_CODE_LEN = 64
+# an osd stat report (~1 Hz when healthy) older than this stops
+# feeding OSD_NEARFULL/OSD_FULL — a silent OSD must not pin HEALTH_ERR
+STAT_REPORT_GRACE = 30.0
 
 
 class MonitorStore:
@@ -281,9 +284,15 @@ class Monitor(Dispatcher):
         self.osdmap = osdmap
         if self.store.last_committed() < osdmap.epoch:
             self.store.put_commit(osdmap.epoch, None, osdmap.encode())
+        # flap guard: the reporter threshold is config-gated
+        # (mon_osd_min_down_reporters) with the constructor value as
+        # the fallback, so an asymmetric partition's single live
+        # reporter cannot keep re-downing a reachable OSD once the
+        # operator raises the bar
+        self._min_reporters_default = min_reporters
         self.failures = FailureAggregator(
             osdmap,
-            min_reporters=min_reporters,
+            min_reporters=self.min_down_reporters,
             mark_down_fn=self._commit_mark_down,
         )
         # subscribers: conn -> last epoch sent
@@ -309,9 +318,40 @@ class Monitor(Dispatcher):
         # OSD_SCRUB_ERRORS / PG_DAMAGED; a zero report clears, stale
         # reports age out like slow-op reports
         self.scrub_reports: dict[str, tuple[float, int, list]] = {}
+        # per-OSD space stats ("osd stat report" upcalls, the
+        # osd_stat_t role): osd -> (wallclock received, kb, kb_used,
+        # kb_avail).  Feeds OSD_NEARFULL / OSD_FULL
+        self.osd_stats: dict[int, tuple[float, int, int, int]] = {}
         # last health-check code set, so transitions (raise/clear)
         # write the cluster log — the health timeline
         self._prev_health: set[str] = set()
+
+    def _config_float(self, key: str) -> float:
+        """One mon option: the centralized config database overrides
+        the schema default ('ceph config set mon <key> <v>')."""
+        raw = self.config_db.get("mon", {}).get(key)
+        if raw is not None:
+            try:
+                return float(raw)
+            except ValueError:
+                pass
+        from ..common.config import SCHEMA
+
+        return float(SCHEMA[key].default)
+
+    def min_down_reporters(self) -> int:
+        """mon_osd_min_down_reporters: config_db gates, the
+        constructor value is the fallback (default 1 in the schema,
+        so stand-alone monitors keep their constructed behavior)."""
+        raw = self.config_db.get("mon", {}).get(
+            "mon_osd_min_down_reporters"
+        )
+        if raw is not None:
+            try:
+                return max(1, int(raw))
+            except ValueError:
+                pass
+        return max(1, int(self._min_reporters_default))
 
     def slow_op_report_grace(self) -> float:
         """mon_slow_op_report_grace: the centralized config database
@@ -399,6 +439,48 @@ class Monitor(Dispatcher):
             checks["OSD_OUT"] = {
                 "severity": "HEALTH_WARN",
                 "summary": f"{len(out)} osds out",
+            }
+        # OSD_NEARFULL / OSD_FULL (OSDMonitor's full-flag checks,
+        # src/mon/OSDMonitor.cc + PGMap::get_health fullness rows):
+        # computed from the freshest per-OSD stat reports; a downed
+        # reporter's stats stop counting (its data re-homes anyway)
+        nearfull_ratio = self._config_float("mon_osd_nearfull_ratio")
+        full_ratio = self._config_float("mon_osd_full_ratio")
+        nearfull_osds: list[int] = []
+        full_osds: list[int] = []
+        stats_now = time.time()
+        for osd, (ts, kb, kb_used, _kb_avail) in list(
+            self.osd_stats.items()
+        ):
+            if not m.is_up(osd):
+                del self.osd_stats[osd]
+                continue
+            if stats_now - ts > STAT_REPORT_GRACE:
+                # an up-but-silent OSD's last report must not pin
+                # OSD_FULL forever (same aging rule as slow-op and
+                # scrub reports); reports flow at ~1 Hz when healthy
+                del self.osd_stats[osd]
+                continue
+            ratio = (kb_used / kb) if kb else 0.0
+            if ratio >= full_ratio:
+                full_osds.append(osd)
+            elif ratio >= nearfull_ratio:
+                nearfull_osds.append(osd)
+        if full_osds:
+            checks["OSD_FULL"] = {
+                "severity": "HEALTH_ERR",
+                "summary": (
+                    f"{len(full_osds)} full osd(s) "
+                    f"{sorted(full_osds)}: writes blocked"
+                ),
+            }
+        if nearfull_osds:
+            checks["OSD_NEARFULL"] = {
+                "severity": "HEALTH_WARN",
+                "summary": (
+                    f"{len(nearfull_osds)} nearfull osd(s) "
+                    f"{sorted(nearfull_osds)}"
+                ),
             }
         # SLOW_OPS: fresh nonzero reports only — a crashed daemon's
         # last report must not pin WARN forever
@@ -502,6 +584,13 @@ class Monitor(Dispatcher):
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
         if isinstance(msg, MMonSubscribe):
+            if msg.from_osd >= 0 and getattr(
+                conn, "peer_label", None
+            ) is None:
+                # stamp the subscriber's identity so directional
+                # fault rules (netsplits) match the mon's map pushes
+                # on this accepted connection too
+                conn.peer_label = f"osd.{msg.from_osd}"
             with self._lock:
                 self._subs[conn] = self.osdmap.epoch
                 reply = self._map_message(msg.start_epoch)
@@ -558,7 +647,8 @@ class Monitor(Dispatcher):
             "log last", "log stat",
             # periodic daemon chatter
             "mds beacon", "mgr beacon", "osd slow ops",
-            "crash report", "osd scrub errors",
+            "crash report", "osd scrub errors", "osd stat report",
+            "osd df",
         }
     )
 
@@ -1071,6 +1161,99 @@ def _cmd_osd_slow_ops(mon: Monitor, cmd: dict) -> MMonCommandReply:
     else:
         mon.slow_ops[daemon] = (time.time(), count, oldest)
     return MMonCommandReply(rc=0, outb=json.dumps({"ok": True}))
+
+
+def _cmd_osd_stat_report(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """Daemon → mon space-stat report (the osd_stat_t carry of
+    MPGStats, reduced to the fullness fields): kb/kb_used/kb_avail
+    from the OSD's store statfs.  Feeds OSD_NEARFULL/OSD_FULL."""
+    try:
+        osd = int(cmd["osd"])
+    except (KeyError, TypeError, ValueError):
+        return MMonCommandReply(rc=-22, outs="missing osd id")
+    kb = max(0, int(cmd.get("kb", 0)))
+    kb_used = max(0, int(cmd.get("kb_used", 0)))
+    kb_avail = max(0, int(cmd.get("kb_avail", 0)))
+    mon.osd_stats[osd] = (time.time(), kb, kb_used, kb_avail)
+    # the reply carries the EFFECTIVE ratios so the OSD's write gate
+    # follows `ceph config set mon mon_osd_full_ratio ...` instead of
+    # diverging from the health check on its local schema default
+    return MMonCommandReply(
+        rc=0,
+        outb=json.dumps(
+            {
+                "ok": True,
+                "nearfull_ratio": mon._config_float(
+                    "mon_osd_nearfull_ratio"
+                ),
+                "full_ratio": mon._config_float(
+                    "mon_osd_full_ratio"
+                ),
+            }
+        ),
+    )
+
+
+def _cmd_osd_df(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """'ceph osd df' (reduced): per-OSD kb/kb_used/kb_avail from the
+    latest stat reports, with the effective full ratios."""
+    return MMonCommandReply(
+        outb=json.dumps(
+            {
+                "nearfull_ratio": mon._config_float(
+                    "mon_osd_nearfull_ratio"
+                ),
+                "full_ratio": mon._config_float("mon_osd_full_ratio"),
+                "nodes": [
+                    {
+                        "osd": osd,
+                        "kb": kb,
+                        "kb_used": kb_used,
+                        "kb_avail": kb_avail,
+                        "utilization": (
+                            kb_used / kb if kb else 0.0
+                        ),
+                    }
+                    for osd, (_ts, kb, kb_used, kb_avail) in sorted(
+                        mon.osd_stats.items()
+                    )
+                ],
+            }
+        )
+    )
+
+
+def _cmd_tell(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """'ceph tell <daemon> <args...>' routing: the mon validates the
+    target and names its address; the CLI dispatches the inner
+    command there as an MCommand (the mon→daemon command route of
+    the reference, collapsed to mon-names/client-dispatches exactly
+    like the scrub orders)."""
+    target = str(cmd.get("target", ""))
+    kind, _, ident = target.partition(".")
+    if kind != "osd" or not ident.isdigit():
+        return MMonCommandReply(
+            rc=-22, outs=f"bad tell target {target!r} (osd.N only)"
+        )
+    osd = int(ident)
+    if not mon.osdmap.is_up(osd):
+        return MMonCommandReply(
+            rc=-11, outs=f"osd.{osd} is down (-EAGAIN)"
+        )
+    addr = mon.osdmap.osd_addrs.get(osd, "")
+    if not addr:
+        return MMonCommandReply(
+            rc=-11, outs=f"osd.{osd} has no address (-EAGAIN)"
+        )
+    return MMonCommandReply(
+        outb=json.dumps(
+            {
+                "target": target,
+                "addr": addr,
+                "args": cmd.get("args", {}),
+            }
+        )
+    )
 
 
 def _cmd_osd_scrub_errors(mon: Monitor, cmd: dict) -> MMonCommandReply:
@@ -1791,6 +1974,9 @@ _COMMANDS = {
     "log": _cmd_log_inject,
     "osd slow ops": _cmd_osd_slow_ops,
     "osd scrub errors": _cmd_osd_scrub_errors,
+    "osd stat report": _cmd_osd_stat_report,
+    "osd df": _cmd_osd_df,
+    "tell": _cmd_tell,
     "pg scrub": _cmd_pg_scrub,
     "pg deep-scrub": _cmd_pg_scrub,
     "pg repair": _cmd_pg_scrub,

@@ -22,7 +22,9 @@ between *processes/hosts*, exactly the role the reference's
 AsyncMessenger plays beneath the OSDs.
 """
 
+from .faults import FaultInjector, FaultRule, build_msgr_perf
 from .message import (
+    MCommand,
     MECSubRead,
     MLog,
     MMonElection,
@@ -30,6 +32,7 @@ from .message import (
     MECSubReadReply,
     MECSubWrite,
     MECSubWriteReply,
+    MOSDBackoff,
     MOSDMap,
     MOSDOp,
     MOSDOpReply,
@@ -58,6 +61,9 @@ from .messenger import Connection, Dispatcher, Messenger
 __all__ = [
     "Connection",
     "Dispatcher",
+    "FaultInjector",
+    "FaultRule",
+    "MCommand",
     "MECSubRead",
     "MLog",
     "MECSubReadReply",
@@ -65,6 +71,7 @@ __all__ = [
     "MECSubWriteReply",
     "MMonElection",
     "MMonPaxos",
+    "MOSDBackoff",
     "MOSDMap",
     "MOSDOp",
     "MOSDOpReply",
@@ -87,5 +94,6 @@ __all__ = [
     "Message",
     "MessageError",
     "Messenger",
+    "build_msgr_perf",
     "register_message",
 ]

@@ -378,6 +378,11 @@ OSD_OP_WATCH = 14  # offset = client cookie
 OSD_OP_UNWATCH = 15  # offset = client cookie
 OSD_OP_NOTIFY = 16  # data = payload; reply.data = encoded ack list
 
+# MOSDOp.flags bits (the CEPH_OSD_FLAG_* seat)
+OSD_FLAG_FULL_TRY = 1  # attempt the write even on a full OSD/pool
+# (repair/delete traffic that FREES space must still land;
+# CEPH_OSD_FLAG_FULL_TRY, src/include/rados.h)
+
 
 @register_message
 @dataclass
@@ -402,12 +407,16 @@ class MOSDOp(Message):
     # self-managed snaps — make_writeable clones against THIS, not
     # the pool's snap_seq, when the writer provides one
     snap_seq: int = 0
+    # op flags (OSD_FLAG_*): FULL_TRY lets repair/delete traffic land
+    # on a full OSD instead of parking on backoff
+    flags: int = 0
 
     def encode_payload(self, e: Encoder) -> None:
         e.s64(self.pool).string(self.pgid).string(self.oid)
         e.u8(self.op).u64(self.offset).s64(self.length)
         e.bytes(self.data).string(self.attr).string(self.reqid)
         e.u32(self.epoch).u64(self.snapid).u64(self.snap_seq)
+        e.u32(self.flags)
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MOSDOp":
@@ -416,6 +425,9 @@ class MOSDOp(Message):
             op=d.u8(), offset=d.u64(), length=d.s64(),
             data=d.bytes(), attr=d.string(), reqid=d.string(),
             epoch=d.u32(), snapid=d.u64(), snap_seq=d.u64(),
+            # versioned-decode tolerance: frames from before the
+            # backoff plane carry no flags word
+            flags=d.u32() if d.remaining() else 0,
         )
 
 
@@ -1120,3 +1132,58 @@ class MLog(Message):
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MLog":
         return cls(name=d.string(), entries=d.string())
+
+
+# MOSDBackoff ops (src/messages/MOSDBackoff.h CEPH_OSD_BACKOFF_OP_*)
+BACKOFF_OP_BLOCK = "block"
+BACKOFF_OP_UNBLOCK = "unblock"
+
+
+@register_message
+@dataclass
+class MOSDBackoff(Message):
+    """OSD → client backoff protocol (src/messages/MOSDBackoff.h +
+    the Backoff struct of src/osd/osd_types.h): when a PG cannot take
+    an op (peering after a partition, OSD full), the OSD answers the
+    op with a tid-paired BLOCK — the Objecter PARKS every op bound
+    for that PG instead of hammering resends — and later sends an
+    un-paired UNBLOCK (same pgid + id) that releases them.  ``reason``
+    ("peering" | "full") is advisory, for dump_backoffs."""
+
+    TYPE = 49
+    op: str = BACKOFF_OP_BLOCK
+    pgid: str = ""
+    id: int = 0
+    reason: str = ""
+    epoch: int = 0
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.op).string(self.pgid).u64(self.id)
+        e.string(self.reason).u32(self.epoch)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MOSDBackoff":
+        return cls(
+            op=d.string(), pgid=d.string(), id=d.u64(),
+            reason=d.string(), epoch=d.u32(),
+        )
+
+
+@register_message
+@dataclass
+class MCommand(Message):
+    """CLI → daemon command (src/messages/MCommand.h): the `ceph
+    tell <daemon> ...` surface — the mon resolves the daemon's
+    address, the CLI dispatches the JSON command dict here, and the
+    daemon answers with MMonCommandReply.  Carries the fault-plane
+    commands (`fault set/clear/list`) and `dump_backoffs`."""
+
+    TYPE = 50
+    cmd: str = "{}"
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.cmd)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MCommand":
+        return cls(cmd=d.string())

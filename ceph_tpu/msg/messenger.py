@@ -24,6 +24,7 @@ import concurrent.futures
 import threading
 import time
 
+from .faults import FaultInjector
 from .message import Message, MessageError
 
 BANNER = b"ceph-tpu-msgr/2\n"
@@ -123,6 +124,11 @@ class Connection:
         self.outgoing = outgoing
         self.peer_addr = writer.get_extra_info("peername")
         self.peer_entity = ""  # authenticated cephx entity ('' = none)
+        # fault-plane destination identity: "host:port" on dialed
+        # connections; accepted connections start unlabeled and a
+        # higher layer may stamp a daemon name (session handshakes,
+        # mon subscriptions) so directional rules can match them
+        self.peer_label: str | None = None
         # pending replies are concurrent futures: resolved from the
         # loop thread, awaited from caller threads (thread-safe both
         # ways, unlike asyncio futures)
@@ -186,22 +192,52 @@ class Connection:
     async def _send(self, msg: Message) -> None:
         if self._closed:
             raise MessageError("connection closed")
-        n = self.msgr.inject_socket_failures
-        if n:
-            self.msgr._inject_count += 1
-            if self.msgr._inject_count % n == 0:
-                await self._close()
-                raise MessageError(
-                    "injected socket failure (ms_inject_socket_failures)"
-                )
-        frame = msg.to_frame()
-        async with self._send_lock:
-            # seal under the send lock: the implicit counter must
-            # match the on-wire record order
-            if self.secure is not None:
-                frame = self.secure.seal(frame)
-            self._writer.write(frame)
-            await self._writer.drain()
+        plan = self.msgr.faults.plan(self)
+        if plan.sockfail:
+            # legacy ms_inject_socket_failures semantics: tear the
+            # connection down instead of transmitting
+            await self._close()
+            raise MessageError(
+                "injected socket failure (ms_inject_socket_failures)"
+            )
+        if plan.drop:
+            return  # netem loss: the frame silently vanishes
+        if plan.delay > 0.0:
+            # deliver later off a task: ordering vs frames sent in
+            # the meantime is deliberately NOT preserved (netem
+            # delay/reorder semantics)
+            self.msgr._loop.create_task(
+                self._delayed_send(msg, plan.delay, plan.duplicate)
+            )
+            return
+        await self._write_frame(msg, duplicate=plan.duplicate)
+
+    async def _delayed_send(
+        self, msg: Message, delay: float, duplicate: bool
+    ) -> None:
+        try:
+            await asyncio.sleep(delay)
+            if not self._closed:
+                await self._write_frame(msg, duplicate=duplicate)
+        except (asyncio.CancelledError, Exception):  # noqa: BLE001 —
+            # a delayed frame racing shutdown/teardown is just lost
+            pass
+
+    async def _write_frame(
+        self, msg: Message, duplicate: bool = False
+    ) -> None:
+        # duplication happens at MESSAGE level: each copy is sealed
+        # with its own counter in secure mode, so both arrive as
+        # valid frames and the receiver's dedup layers really work
+        for _ in range(2 if duplicate else 1):
+            frame = msg.to_frame()
+            async with self._send_lock:
+                # seal under the send lock: the implicit counter must
+                # match the on-wire record order
+                if self.secure is not None:
+                    frame = self.secure.seal(frame)
+                self._writer.write(frame)
+                await self._writer.drain()
 
     async def _read_loop(self) -> None:
         try:
@@ -329,11 +365,22 @@ class Messenger:
         self._session_service = None
         self._session_conns: dict[tuple, object] = {}
         self._session_lock = threading.Lock()
-        # fault injection (ms_inject_socket_failures,
-        # src/common/options.cc:1087): every Nth outbound frame tears
-        # the connection down instead of sending; 0 = off
-        self.inject_socket_failures = 0
-        self._inject_count = 0
+        # fault-injection plane (msg/faults.py): netem-style rules,
+        # partitions, and the legacy ms_inject_socket_failures knob
+        self.faults = FaultInjector(name)
+
+    @property
+    def inject_socket_failures(self) -> int:
+        """Legacy knob (ms_inject_socket_failures,
+        src/common/options.cc:1087): every Nth outbound frame PER
+        CONNECTION tears the connection down instead of sending;
+        0 = off.  Lives on the FaultInjector so both fault paths
+        share one code path and counter set."""
+        return self.faults.socket_failure_every
+
+    @inject_socket_failures.setter
+    def inject_socket_failures(self, n: int) -> None:
+        self.faults.socket_failure_every = max(0, int(n))
 
     # -- lossless-peer sessions (ProtocolV2 reconnect/replay role) ---------
     def _sessions(self):
@@ -451,6 +498,7 @@ class Messenger:
             elif mode != b"N":
                 raise MessageError("bad auth negotiation byte")
             conn = Connection(self, reader, writer, outgoing=True)
+            conn.peer_label = f"{host}:{port}"
             if mode == b"S":
                 conn.secure = SecureCtx(
                     self.auth_client.session.secret,

@@ -390,6 +390,11 @@ class _ServerSession:
 
     def handle_open(self, conn: Connection, msg: MSessionOpen):
         self.conn = conn
+        if conn.peer_label is None and "-" in msg.session:
+            # session names are "<dialer>-<peer id>" (osd._peer_conn):
+            # stamp the dialer's identity so directional fault rules
+            # match this accepted connection's replies too
+            conn.peer_label = msg.session.rsplit("-", 1)[0]
         if msg.nonce != self.nonce:
             # a NEW dialer incarnation: BOTH seq spaces restart from
             # zero (keeping the old out_seq would make every reply a

@@ -98,8 +98,13 @@ from .scheduler import (
     WeightedPriorityQueue,
 )
 from ..msg.message import (
+    BACKOFF_OP_BLOCK,
+    BACKOFF_OP_UNBLOCK,
+    MCommand,
+    MOSDBackoff,
     MRecoveryReserve,
     MMgrReport,
+    OSD_FLAG_FULL_TRY,
     OSD_OP_APPEND,
     OSD_OP_CALL,
     OSD_OP_DELETE,
@@ -277,6 +282,14 @@ def build_osd_perf(whoami: int):
             "scrub_last_age",
             "seconds since the stalest primary pg was scrubbed",
         )
+        # fullness plane (the l_osd stat_bytes family): the same
+        # numbers the stat reports carry to the mon
+        .add_u64_gauge("stat_bytes", "store capacity bytes")
+        .add_u64_gauge("stat_bytes_used", "store bytes used")
+        .add_u64_gauge("stat_bytes_avail", "store bytes available")
+        .add_u64_gauge(
+            "backoffs_active", "client backoffs currently blocked"
+        )
         .create_perf_counters()
     )
 
@@ -374,6 +387,13 @@ class OSD(Dispatcher):
             )
             self.op_tracker.register_admin_commands(self.admin)
             self.tracer.register_admin_commands(self.admin)
+            # fault plane: `ceph daemon osd.N fault set/clear/list`
+            self.messenger.faults.register_admin_commands(self.admin)
+            self.admin.register_command(
+                "dump_backoffs",
+                lambda args: self.dump_backoffs(),
+                "dump client backoffs this OSD holds",
+            )
             self.admin.start()
         self._shard_server = ShardServer(
             self.store, whoami,
@@ -406,6 +426,7 @@ class OSD(Dispatcher):
 
             self.admin.perf.add(self.perf)
             self.admin.perf.add(kernel_stats().perf)
+            self.admin.perf.add(self.messenger.faults.perf)
         # SLOW_OPS watchdog state (osd_op_complaint_time): last count
         # reported to the mon + report throttle stamp
         self._slow_ops_last_report = 0.0
@@ -446,6 +467,23 @@ class OSD(Dispatcher):
         self.log_keep = 128  # pg_log length bound (osd_min_pg_log_entries role)
         self.class_handler = default_handler  # ClassHandler role
         self.addr: tuple[str, int] | None = None
+        # repop sub-op timeout (tests shrink it so chaos partitions
+        # fail fast instead of wedging the worker for 10s per write)
+        self.repop_timeout = 10.0
+        # RADOS backoff protocol state (the Backoff registry of
+        # src/osd/osd_types.h, session-scoped in the reference;
+        # keyed by id here): id -> {pgid, reason, conn, since}
+        self._backoffs: dict[int, dict] = {}
+        self._backoff_seq = itertools.count(1)
+        self._backoff_lock = threading.Lock()
+        # store statfs is a walk — cache it at ~tick rate
+        self._statfs_cache: tuple[float, dict] | None = None
+        self._stat_report_last = 0.0
+        self._stat_report_inflight = False
+        # the mon's EFFECTIVE full ratio, learned from the stat-report
+        # reply (runtime `ceph config set mon mon_osd_full_ratio`);
+        # None until the first report lands — local config gates then
+        self._mon_full_ratio: float | None = None
         # peers this OSD has filed failure reports for (to withdraw
         # with failed_for=-1 when they speak again — send_still_alive)
         self._reported: set[int] = set()
@@ -1179,6 +1217,18 @@ class OSD(Dispatcher):
             reply.error = "client is blocklisted (-EBLOCKLISTED)"
             conn.send(reply)
             return
+        if (
+            pg is not None
+            and pg.primary == self.whoami
+            and pg.state == "peering"
+        ):
+            # the PG cannot take ops while peering (e.g. after an
+            # injected partition changed the interval): send a block
+            # backoff so the objecter PARKS the op instead of
+            # hammering resends (MOSDBackoff, the reference's PG
+            # backoff on a not-yet-active primary)
+            self._send_block(conn, msg, pg.pgid, "peering")
+            return
         if pg is None or pg.primary != self.whoami or pg.state not in (
             "active",
         ):
@@ -1198,6 +1248,16 @@ class OSD(Dispatcher):
                 f"{pool.last_change}; refresh map (-EAGAIN)"
             )
             conn.send(reply)
+            return
+        if (
+            self._op_is_write(msg)
+            and not (msg.flags & OSD_FLAG_FULL_TRY)
+            and self._check_full()
+        ):
+            # full-space degradation (the OSD_FULL write-blocking
+            # path): reads keep serving, writes park on backoff until
+            # space frees; FULL_TRY (repair/delete traffic) bypasses
+            self._send_block(conn, msg, pg.pgid, "full")
             return
         store_oid = OBJ_PREFIX + msg.oid
         is_ec = self._is_ec(pg)
@@ -1862,7 +1922,7 @@ class OSD(Dispatcher):
                         pgid=pg.pgid, epoch=epoch, txn=txn,
                         entry_blob=entry_blob, trace=msg.reqid,
                     ),
-                    timeout=10.0,
+                    timeout=self.repop_timeout,
                 )
                 if isinstance(ack, MOSDRepOpReply) and not ack.ok:
                     failed.append(osd)
@@ -2418,6 +2478,9 @@ class OSD(Dispatcher):
         if isinstance(msg, MScrubCommand):
             self._handle_scrub_command(conn, msg)
             return True
+        if isinstance(msg, MCommand):
+            self._handle_tell(conn, msg)
+            return True
         if isinstance(msg, MPGActivate):
             # rollback may re-pull objects (nested RPC) → worker queue
             self._workq.put(("activate", conn, msg))
@@ -2440,6 +2503,232 @@ class OSD(Dispatcher):
                 )
             return True
         return False
+
+    # -- backoff protocol + full-space degradation -------------------------
+    _READ_OPS = frozenset(
+        (
+            OSD_OP_READ, OSD_OP_STAT, OSD_OP_GETXATTR,
+            OSD_OP_OMAPGET, OSD_OP_LIST,
+        )
+    )
+
+    def _op_is_write(self, msg: MOSDOp) -> bool:
+        """True for ops that consume the mutation path (fullness
+        gates these; watch/notify bookkeeping and reads pass)."""
+        if msg.op in self._READ_OPS or msg.op in (
+            OSD_OP_WATCH, OSD_OP_UNWATCH, OSD_OP_NOTIFY,
+        ):
+            return False
+        if msg.op == OSD_OP_CALL:
+            cls_name, _, method = msg.attr.partition(".")
+            try:
+                return bool(
+                    self.class_handler.flags_of(cls_name, method)
+                    & CLS_WR
+                )
+            except Exception:  # noqa: BLE001 — unknown method: the
+                # op will fail anyway; classify conservatively
+                return True
+        return True
+
+    def statfs(self) -> dict:
+        """Store statfs, cached at ~tick granularity (the walk is
+        O(objects); the op path consults this per mutation)."""
+        now = time.monotonic()
+        cached = self._statfs_cache
+        if cached is not None and now - cached[0] < 0.5:
+            return cached[1]
+        stats = self.store.statfs()
+        self._statfs_cache = (now, stats)
+        return stats
+
+    def _check_full(self) -> bool:
+        stats = self.statfs()
+        total = stats["total"]
+        if total <= 0:
+            return False
+        ratio = (
+            self._mon_full_ratio
+            if self._mon_full_ratio is not None
+            else float(self.config.get("mon_osd_full_ratio"))
+        )
+        return stats["used"] / total >= ratio
+
+    def _send_block(
+        self, conn: Connection, msg: MOSDOp, pgid: str, reason: str
+    ) -> None:
+        """Answer the op with a tid-paired BLOCK backoff and record
+        it; the tick loop unblocks when the condition clears.  One
+        logical backoff per (conn, pgid): a parked client's bounded
+        re-probes re-use the existing id instead of growing the
+        registry for the life of the condition."""
+        with self._backoff_lock:
+            existing = next(
+                (
+                    b for b in self._backoffs.values()
+                    if b["conn"] is conn and b["pgid"] == pgid
+                ),
+                None,
+            )
+            if existing is not None:
+                existing["reason"] = reason
+                bid = existing["id"]
+            else:
+                bid = next(self._backoff_seq)
+                self._backoffs[bid] = {
+                    "id": bid,
+                    "pgid": pgid,
+                    "reason": reason,
+                    "conn": conn,
+                    "since": time.monotonic(),
+                }
+        try:
+            conn.send(
+                MOSDBackoff(
+                    tid=msg.tid, op=BACKOFF_OP_BLOCK, pgid=pgid,
+                    id=bid, reason=reason, epoch=self.monc.epoch,
+                )
+            )
+        except (MessageError, OSError):
+            with self._backoff_lock:
+                self._backoffs.pop(bid, None)
+
+    def _release_backoffs(self) -> None:
+        """Tick-driven unblock: a backoff whose condition cleared
+        (space freed, PG finished peering) releases the client's
+        parked ops; dead connections drop theirs."""
+        with self._backoff_lock:
+            snapshot = list(self._backoffs.values())
+        if not snapshot:
+            return
+        full = self._check_full()
+        for b in snapshot:
+            conn = b["conn"]
+            if getattr(conn, "is_closed", False):
+                with self._backoff_lock:
+                    self._backoffs.pop(b["id"], None)
+                continue
+            if b["reason"] == "full":
+                release = not full
+            else:  # peering
+                pg = self.pgs.get(b["pgid"])
+                release = (
+                    pg is None
+                    or pg.primary != self.whoami
+                    or pg.state == "active"
+                )
+            if not release:
+                continue
+            with self._backoff_lock:
+                self._backoffs.pop(b["id"], None)
+            try:
+                conn.send(
+                    MOSDBackoff(
+                        # even tid space: an accepting-side send must
+                        # never collide with the client's in-flight
+                        # odd call tids (it would be consumed as that
+                        # op's reply and the release lost)
+                        tid=self.messenger.new_even_tid(),
+                        op=BACKOFF_OP_UNBLOCK,
+                        pgid=b["pgid"], id=b["id"],
+                        reason=b["reason"], epoch=self.monc.epoch,
+                    )
+                )
+            except (MessageError, OSError):
+                pass  # the client's map-change fallback unparks it
+
+    def dump_backoffs(self) -> list[dict]:
+        now = time.monotonic()
+        with self._backoff_lock:
+            return [
+                {
+                    "id": b["id"],
+                    "pgid": b["pgid"],
+                    "reason": b["reason"],
+                    "age": round(now - b["since"], 3),
+                }
+                for b in self._backoffs.values()
+            ]
+
+    def _report_stats(self, now: float) -> None:
+        """Push kb/kb_used/kb_avail to the mon (~1 Hz) — the
+        osd_stat_t report feeding OSD_NEARFULL/OSD_FULL.  The command
+        round-trip runs OFF the tick thread (at most one in flight):
+        a partitioned mon must not stall the heartbeat path — ticks
+        blocked behind a 2s command timeout would make THIS OSD file
+        spurious failure reports for every reachable peer."""
+        if now - self._stat_report_last < 1.0:
+            return
+        self._stat_report_last = now
+        stats = self.statfs()
+        self.perf.set("stat_bytes", stats["total"])
+        self.perf.set("stat_bytes_used", stats["used"])
+        self.perf.set("stat_bytes_avail", stats["avail"])
+        if self._stat_report_inflight:
+            return
+        self._stat_report_inflight = True
+        threading.Thread(
+            target=self._send_stat_report,
+            args=(stats,),
+            name=f"osd.{self.whoami}.statrep",
+            daemon=True,
+        ).start()
+
+    def _send_stat_report(self, stats: dict) -> None:
+        try:
+            reply = self.monc.command(
+                {
+                    "prefix": "osd stat report",
+                    "osd": self.whoami,
+                    "kb": stats["total"] // 1024,
+                    "kb_used": stats["used"] // 1024,
+                    "kb_avail": stats["avail"] // 1024,
+                },
+                timeout=2.0,
+            )
+            if reply.rc == 0 and reply.outb:
+                ratio = json.loads(reply.outb).get("full_ratio")
+                if ratio is not None:
+                    self._mon_full_ratio = float(ratio)
+        except (MessageError, OSError, ValueError, TypeError):
+            pass  # the next tick's report retries
+        finally:
+            self._stat_report_inflight = False
+
+    def _handle_tell(self, conn: Connection, msg: MCommand) -> None:
+        """`ceph tell osd.N ...` service (MCommand): the fault-plane
+        commands and dump_backoffs, answered inline."""
+        from ..msg.message import MMonCommandReply
+
+        reply = MMonCommandReply(tid=msg.tid)
+        try:
+            cmd = json.loads(msg.cmd)
+            prefix = str(cmd.get("prefix", ""))
+            if prefix.startswith("fault"):
+                op = prefix.split(" ", 1)[1] if " " in prefix else ""
+                args = {
+                    k: v for k, v in cmd.items() if k != "prefix"
+                }
+                args["op"] = op or args.get("op", "list")
+                reply.outb = json.dumps(
+                    self.messenger.faults.command(args)
+                )
+            elif prefix == "dump_backoffs":
+                reply.outb = json.dumps(self.dump_backoffs())
+            elif prefix == "perf dump":
+                dump = dict(self.perf.dump())
+                dump.update(self.messenger.faults.perf.dump())
+                reply.outb = json.dumps(dump)
+            else:
+                reply.rc = -22
+                reply.outs = f"unknown tell command {prefix!r}"
+        except (ValueError, TypeError, KeyError) as e:
+            reply.rc = -22
+            reply.outs = f"{type(e).__name__}: {e}"
+        try:
+            conn.send(reply)
+        except (MessageError, OSError):
+            pass
 
     # -- scrub plane (osd/scrub.py drives; these are the wire ends) --------
     def _handle_rep_scrub(self, conn: Connection, msg: MRepScrub):
@@ -2542,6 +2831,11 @@ class OSD(Dispatcher):
             ):
                 if c is conn:
                     del self._remote_reservations[k]
+        # a dead client takes its backoffs: nothing to unblock
+        with self._backoff_lock:
+            for bid, b in list(self._backoffs.items()):
+                if b["conn"] is conn:
+                    del self._backoffs[bid]
         with self._watch_lock:
             for key in list(self._watchers):
                 watchers = self._watchers[key]
@@ -2706,8 +3000,13 @@ class OSD(Dispatcher):
             # existing perf dump → MMgrReport → /metrics pipeline
             from ..ops.kernel_stats import kernel_stats
 
+            with self._backoff_lock:
+                self.perf.set("backoffs_active", len(self._backoffs))
             dump = dict(self.perf.dump())
             dump.update(kernel_stats().dump())
+            # fault-plane counters (l_msgr_fault_*) ride the same
+            # perf → MMgrReport → prometheus pipe
+            dump.update(self.messenger.faults.perf.dump())
             spans = (
                 self.tracer.drain()
                 if self.config.get("tracing_enabled")
@@ -3298,6 +3597,10 @@ class OSD(Dispatcher):
             except (MessageError, OSError):
                 pass
         self._check_slow_ops(now)
+        # backoff releases (space freed / peering done) + the space
+        # stats that feed the mon's OSD_NEARFULL/OSD_FULL checks
+        self._release_backoffs()
+        self._report_stats(now)
         self._flush_clog()
 
     def _flush_clog(self) -> None:

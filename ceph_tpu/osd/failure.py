@@ -65,18 +65,27 @@ class FailureAggregator:
     def __init__(
         self,
         osdmap: OSDMap,
-        min_reporters: int = MON_OSD_MIN_DOWN_REPORTERS,
+        min_reporters=MON_OSD_MIN_DOWN_REPORTERS,
         mark_down_fn=None,
     ):
         """``mark_down_fn(target)`` commits the down marking; the
         default mutates the map in place with a bare epoch bump (test
         convenience).  The monitor passes its own committer so the
         marking becomes a real Incremental pushed to subscribers
-        (mon/monitor.py)."""
+        (mon/monitor.py).
+
+        ``min_reporters`` may be an int or a zero-arg callable — the
+        monitor passes a callable reading its centralized config
+        (mon_osd_min_down_reporters), so `ceph config set mon
+        mon_osd_min_down_reporters N` takes effect at runtime."""
         self.osdmap = osdmap
         self.min_reporters = min_reporters
         self.mark_down_fn = mark_down_fn
         self._pending: dict[int, _Pending] = {}
+
+    def _threshold(self) -> int:
+        mr = self.min_reporters
+        return max(1, int(mr() if callable(mr) else mr))
 
     def report_failure(
         self, target: int, reporter: int, now: float
@@ -96,13 +105,14 @@ class FailureAggregator:
         p.reporters = {
             r for r in p.reporters if self.osdmap.is_up(r)
         }
+        threshold = self._threshold()
         dout(
             "osd",
             5,
             f"failure report: osd.{target} by osd.{reporter} "
-            f"({len(p.reporters)}/{self.min_reporters})",
+            f"({len(p.reporters)}/{threshold})",
         )
-        if len(p.reporters) >= self.min_reporters:
+        if len(p.reporters) >= threshold:
             self._mark_down(target)
             return True
         return False

@@ -16,12 +16,20 @@ from __future__ import annotations
 
 import itertools
 import os
+import threading
 import time
 
 from ..common import tracing
 from ..crush.hashing import ceph_str_hash_rjenkins
-from ..msg import Messenger, MessageError, MOSDOp, MOSDOpReply
-from ..msg.messenger import Connection
+from ..msg import (
+    Messenger,
+    MessageError,
+    MOSDBackoff,
+    MOSDOp,
+    MOSDOpReply,
+)
+from ..msg.message import BACKOFF_OP_BLOCK, BACKOFF_OP_UNBLOCK
+from ..msg.messenger import Connection, Dispatcher
 
 
 class RadosError(Exception):
@@ -50,12 +58,19 @@ def object_to_pg(pool, oid: str) -> str:
     return f"{pool.pool_id}.{ps}"
 
 
-class Objecter:
+class Objecter(Dispatcher):
     def __init__(self, monc, messenger: Messenger, op_timeout: float = 15.0):
         self.monc = monc
         self.messenger = messenger
         self.op_timeout = op_timeout
         self._conns: dict[int, Connection] = {}
+        # RADOS backoffs (Objecter::_session_backoff role, keyed by
+        # pgid): a BLOCKed pg parks its ops on the event instead of
+        # resending; UNBLOCK (or a primary change) releases them
+        self._backoffs: dict[str, dict] = {}
+        self._backoff_lock = threading.Lock()
+        self.backoff_parks = 0  # ops that parked at least once
+        messenger.add_dispatcher(self)  # UNBLOCK arrives un-paired
         # osd_reqid_t role: a stable id per logical op so retries are
         # deduped by the primary (append idempotency)
         self._client_id = os.urandom(6).hex()
@@ -129,6 +144,98 @@ class Objecter:
         )
         return pgid, primary
 
+    # -- backoff protocol (MOSDBackoff client half) -------------------------
+    def ms_dispatch(self, conn, msg) -> bool:
+        if not isinstance(msg, MOSDBackoff):
+            return False
+        # only an UNBLOCK releases — a duplicated or timed-out BLOCK
+        # copy arriving un-paired must NOT wake the parked ops into
+        # the still-blocked PG; and the id must match the backoff we
+        # hold (a stale UNBLOCK for a dead incarnation is ignored —
+        # the bounded re-probe covers truly lost releases)
+        if msg.op != BACKOFF_OP_UNBLOCK:
+            return True
+        with self._backoff_lock:
+            ent = self._backoffs.get(msg.pgid)
+            if ent is None or ent.get("id") not in (0, msg.id):
+                return True
+            del self._backoffs[msg.pgid]
+        ent["event"].set()
+        return True
+
+    def _register_backoff(self, msg: MOSDBackoff, osd: int) -> None:
+        with self._backoff_lock:
+            ent = self._backoffs.get(msg.pgid)
+            if ent is None:
+                ent = self._backoffs[msg.pgid] = {
+                    "event": threading.Event(),
+                    "since": time.monotonic(),
+                }
+            ent.update(
+                {
+                    "id": msg.id,
+                    "reason": msg.reason,
+                    "osd": osd,
+                    "epoch": msg.epoch,
+                }
+            )
+
+    # a lost UNBLOCK (it is a fire-and-forget frame — chaos rules can
+    # drop it) must not park an op until its deadline: after this
+    # long, re-probe with ONE resend (the OSD re-blocks if the
+    # condition still holds)
+    BACKOFF_RECHECK = 3.0
+
+    def _wait_backoff(self, pgid: str, deadline: float) -> None:
+        """PARK until the backoff releases: the unblock event, a
+        primary change (the interval ended — the reference clears
+        session backoffs on map change), a bounded re-probe, or the
+        op deadline.  No sends happen while parked — that is the
+        whole point (no futile resend storm)."""
+        self.backoff_parks += 1
+        recheck = time.monotonic() + self.BACKOFF_RECHECK
+        while time.monotonic() < deadline:
+            if time.monotonic() >= recheck:
+                with self._backoff_lock:
+                    self._backoffs.pop(pgid, None)
+                return
+            with self._backoff_lock:
+                ent = self._backoffs.get(pgid)
+            if ent is None:
+                return  # unblocked
+            if ent["event"].wait(0.25):
+                return
+            try:
+                if self._pg_primary(pgid) != ent["osd"]:
+                    # the blocking primary is gone: the backoff died
+                    # with its interval — retarget and resend
+                    with self._backoff_lock:
+                        self._backoffs.pop(pgid, None)
+                    return
+            except (ObjecterError, ValueError, KeyError):
+                pass
+        # deadline lapsed while parked: drop the entry so the NEXT
+        # op to this pg sends instead of parking against a backoff
+        # the OSD may no longer hold
+        with self._backoff_lock:
+            self._backoffs.pop(pgid, None)
+
+    def dump_backoffs(self) -> list[dict]:
+        """Client-side `dump_backoffs` (objecter_requests' backoff
+        block): the pgs currently parked and why."""
+        now = time.monotonic()
+        with self._backoff_lock:
+            return [
+                {
+                    "pgid": pgid,
+                    "id": ent.get("id", 0),
+                    "reason": ent.get("reason", ""),
+                    "osd": ent.get("osd", -1),
+                    "age": round(now - ent["since"], 3),
+                }
+                for pgid, ent in self._backoffs.items()
+            ]
+
     def _conn_to(self, osd: int) -> Connection:
         conn = self._conns.get(osd)
         if conn is not None and not conn._closed:
@@ -154,6 +261,7 @@ class Objecter:
         pgid: str | None = None,
         snapid: int = 0,
         snap_seq: int = 0,
+        flags: int = 0,
     ) -> MOSDOpReply:
         """Target, send, and retry until acked or timed out."""
         from ..msg.message import (
@@ -181,12 +289,13 @@ class Objecter:
             return self._op_submit_attempts(
                 root, deadline, last_err, reqid, pool_id, oid,
                 op, offset, length, data, attr, pgid, snapid,
-                snap_seq, is_read,
+                snap_seq, is_read, flags,
             )
 
     def _op_submit_attempts(
         self, root, deadline, last_err, reqid, pool_id, oid, op,
         offset, length, data, attr, pgid, snapid, snap_seq, is_read,
+        flags,
     ) -> MOSDOpReply:
         from ..msg.message import OSD_OP_LIST
 
@@ -216,10 +325,27 @@ class Objecter:
                         pool=eff_pool, pgid=tgt_pgid, oid=oid, op=op,
                         offset=offset, length=length, data=data,
                         attr=attr, reqid=reqid, epoch=self.monc.epoch,
-                        snapid=snapid, snap_seq=snap_seq,
+                        snapid=snapid, snap_seq=snap_seq, flags=flags,
                     ),
                     timeout=min(5.0, self.op_timeout),
                 )
+                if isinstance(reply, MOSDBackoff):
+                    # tid-paired BLOCK: the PG cannot take this op
+                    # (peering / full) — PARK on the backoff instead
+                    # of hammering resends; UNBLOCK (or a primary
+                    # change) releases us back into the loop
+                    if reply.op == BACKOFF_OP_BLOCK:
+                        last_err = (
+                            f"backoff pg {tgt_pgid} ({reply.reason})"
+                        )
+                        root.mark_event(
+                            f"backoff_block pg {tgt_pgid} "
+                            f"({reply.reason})"
+                        )
+                        self._register_backoff(reply, primary)
+                        self._wait_backoff(tgt_pgid, deadline)
+                        root.mark_event("backoff_release")
+                    continue
                 assert isinstance(reply, MOSDOpReply)
                 if reply.ok:
                     root.mark_event("reply_ok")

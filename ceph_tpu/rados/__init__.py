@@ -21,6 +21,7 @@ from ..common.encoding import Decoder, Encoder
 from ..mon.monitor import MonClient
 from ..msg import Messenger
 from ..msg.message import (
+    OSD_FLAG_FULL_TRY,
     OSD_OP_APPEND,
     OSD_OP_CALL,
     OSD_OP_DELETE,
@@ -282,18 +283,34 @@ class IoCtx:
         # writer SnapContext seq (rados_ioctx_selfmanaged_snap_
         # set_write_ctx): 0 = follow the pool's snaps
         self.write_snap_seq = 0
+        # rados_set_pool_full_try: mutations from this handle carry
+        # OSD_FLAG_FULL_TRY, so repair/delete traffic that FREES
+        # space still lands on a full OSD instead of parking on
+        # backoff
+        self.full_try = False
+
+    def set_pool_full_try(self, enabled: bool = True) -> None:
+        self.full_try = bool(enabled)
+
+    def _mut_flags(self, full_try: bool = False) -> int:
+        return (
+            OSD_FLAG_FULL_TRY
+            if (self.full_try or full_try)
+            else 0
+        )
 
     # -- sync data ops -----------------------------------------------------
     def write_full(self, oid: str, data: bytes) -> None:
         self.rados.objecter.op_submit(
             self.pool_id, oid, OSD_OP_WRITEFULL, data=bytes(data),
-            snap_seq=self.write_snap_seq,
+            snap_seq=self.write_snap_seq, flags=self._mut_flags(),
         )
 
     def write(self, oid: str, data: bytes, offset: int = 0) -> None:
         self.rados.objecter.op_submit(
             self.pool_id, oid, OSD_OP_WRITE, offset=offset,
             data=bytes(data), snap_seq=self.write_snap_seq,
+            flags=self._mut_flags(),
         )
 
     def append(self, oid: str, data: bytes) -> None:
@@ -302,7 +319,7 @@ class IoCtx:
         concurrent appenders)."""
         self.rados.objecter.op_submit(
             self.pool_id, oid, OSD_OP_APPEND, data=bytes(data),
-            snap_seq=self.write_snap_seq,
+            snap_seq=self.write_snap_seq, flags=self._mut_flags(),
         )
 
     def read(
@@ -321,9 +338,13 @@ class IoCtx:
         )
         return reply.data
 
-    def remove(self, oid: str) -> None:
+    def remove(self, oid: str, full_try: bool = False) -> None:
+        """``full_try`` lets THIS delete land on a full OSD
+        (OSD_FLAG_FULL_TRY) without flipping the whole handle —
+        the space-reclaim path out of OSD_FULL."""
         self.rados.objecter.op_submit(
-            self.pool_id, oid, OSD_OP_DELETE
+            self.pool_id, oid, OSD_OP_DELETE,
+            flags=self._mut_flags(full_try),
         )
 
     def stat(self, oid: str) -> int:
@@ -459,7 +480,7 @@ class IoCtx:
     def set_xattr(self, oid: str, name: str, value: bytes) -> None:
         self.rados.objecter.op_submit(
             self.pool_id, oid, OSD_OP_SETXATTR, attr=name,
-            data=bytes(value),
+            data=bytes(value), flags=self._mut_flags(),
         )
 
     def get_xattr(self, oid: str, name: str) -> bytes:
@@ -478,7 +499,8 @@ class IoCtx:
             lambda e2, v: e2.bytes(bytes(v)),
         )
         self.rados.objecter.op_submit(
-            self.pool_id, oid, OSD_OP_OMAPSET, data=e.getvalue()
+            self.pool_id, oid, OSD_OP_OMAPSET, data=e.getvalue(),
+            flags=self._mut_flags(),
         )
 
     def omap_get_vals(
@@ -501,22 +523,27 @@ class IoCtx:
         e = Encoder()
         e.list(list(keys), lambda e2, k: e2.string(k))
         self.rados.objecter.op_submit(
-            self.pool_id, oid, OSD_OP_OMAPRM, data=e.getvalue()
+            self.pool_id, oid, OSD_OP_OMAPRM, data=e.getvalue(),
+            flags=self._mut_flags(),
         )
 
     def omap_clear(self, oid: str) -> None:
         self.rados.objecter.op_submit(
-            self.pool_id, oid, OSD_OP_OMAPCLEAR
+            self.pool_id, oid, OSD_OP_OMAPCLEAR,
+            flags=self._mut_flags(),
         )
 
     def execute(
         self, oid: str, cls: str, method: str, indata: bytes = b""
     ) -> bytes:
         """Object-class call (rados_exec / IoCtx::exec → the in-OSD
-        ClassHandler dispatch)."""
+        ClassHandler dispatch).  Carries the handle's FULL_TRY flag:
+        the OSD classifies CLS_WR methods as writes, so a reclaim
+        class call must not park on a full OSD."""
         reply = self.rados.objecter.op_submit(
             self.pool_id, oid, OSD_OP_CALL,
             attr=f"{cls}.{method}", data=bytes(indata),
+            flags=self._mut_flags(),
         )
         return reply.data
 

@@ -323,6 +323,21 @@ class BlockStore(ObjectStore):
         self.alloc = _Allocator()
         self._rebuild_allocator()
 
+    def statfs(self) -> dict:
+        # allocator accounting (O(free runs)): used = everything ever
+        # allocated below the frontier minus the free runs — no onode
+        # walk on the ~1 Hz fullness poll or the write hot path
+        with self._lock:
+            used = self.alloc.frontier - sum(
+                run[1] for run in self.alloc.free
+            )
+        total = int(self.total_bytes)
+        return {
+            "total": total,
+            "used": max(0, used),
+            "avail": max(0, total - used),
+        }
+
     def _rebuild_allocator(self) -> None:
         used = []
         for key, val in self.kv.db.items():

@@ -97,8 +97,38 @@ class ObjectStore:
     """The abstract boundary (ObjectStore.h): transactions in, reads
     out."""
 
+    # advertised capacity for statfs (ObjectStore::statfs role):
+    # tests shrink it to exercise full/nearfull handling; concrete
+    # stores may override statfs with a cheaper accounting
+    total_bytes = 1 << 30
+
     def queue_transaction(self, txn: Transaction) -> None:
         raise NotImplementedError
+
+    def statfs(self) -> dict:
+        """{total, used, avail} bytes (store_statfs_t reduced) — the
+        source of the OSD's kb_used/kb_avail stat reports and the
+        mon's OSD_NEARFULL/OSD_FULL checks.  Default: walk object
+        sizes (callers cache; the OSD polls at ~1 Hz).  Concrete
+        stores override with their own accounting (MemStore's object
+        dicts, BlockStore's allocator) — the walk is the fallback
+        for stores with nothing cheaper."""
+        used = 0
+        try:
+            for cid in self.list_collections():
+                for oid in self.list_objects(cid):
+                    try:
+                        used += self.stat(cid, oid)
+                    except StoreError:
+                        continue
+        except StoreError:
+            pass
+        total = int(self.total_bytes)
+        return {
+            "total": total,
+            "used": used,
+            "avail": max(0, total - used),
+        }
 
     def read(self, cid: str, oid: str, offset: int = 0, length: int = -1) -> bytes:
         raise NotImplementedError
@@ -324,6 +354,22 @@ class MemStore(ObjectStore):
     def stat(self, cid, oid) -> int:
         with self._lock:
             return len(self._get(cid, oid).data)
+
+    def statfs(self) -> dict:
+        # one locked pass over the in-memory dicts — no per-object
+        # stat() round-trips like the base-class fallback walk
+        with self._lock:
+            used = sum(
+                len(obj.data)
+                for objs in self._colls.values()
+                for obj in objs.values()
+            )
+        total = int(self.total_bytes)
+        return {
+            "total": total,
+            "used": used,
+            "avail": max(0, total - used),
+        }
 
     def exists(self, cid, oid) -> bool:
         with self._lock:

@@ -28,10 +28,63 @@ from ..mon.monitor import MonClient
 from ..msg import Messenger
 
 
+def _coerce(v: str):
+    """key=value coercion for tell/fault arguments."""
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        return v
+
+
+def _build_tell_args(args: list[str]) -> dict:
+    """The inner `ceph tell osd.N <cmd>` grammar: `fault set
+    [dst=X] [drop=P] [delay=S] [jitter=S] [dup=P] [reorder=P]` /
+    `fault set partition=NAME groups=a,b;c,d` / `fault clear
+    [id=N | partition=NAME]` / `fault list` / `fault seed N` /
+    `dump_backoffs` / `perf dump`."""
+    if not args:
+        raise SystemExit("tell: missing daemon command")
+    if args[0] == "fault":
+        if len(args) < 2:
+            raise SystemExit("tell: fault set|clear|list|seed ...")
+        cmd: dict = {"prefix": f"fault {args[1]}"}
+        if args[1] == "seed" and len(args) > 2:
+            cmd["seed"] = int(args[2])
+            cmd["prefix"] = "fault seed"
+            return cmd
+        for kv in args[2:]:
+            k, _, v = kv.partition("=")
+            if k == "groups":
+                # a,b;c,d → [["a","b"],["c","d"]]
+                cmd[k] = [
+                    [m for m in grp.split(",") if m]
+                    for grp in v.split(";")
+                ]
+            else:
+                cmd[k] = _coerce(v)
+        return cmd
+    return {"prefix": " ".join(args)}
+
+
 def _build_command(args: list[str]) -> dict:
     """argv tail → JSON command (the MonCommands.h translation)."""
     joined = " ".join(args)
     # longest-prefix match over the known command table shapes
+    if args[0] == "tell" and len(args) >= 3:
+        # `ceph tell osd.N ...`: the mon validates the target and
+        # names its address; main() dispatches the inner command
+        # there as an MCommand
+        return {
+            "prefix": "tell",
+            "target": args[1],
+            "args": _build_tell_args(args[2:]),
+        }
+    if joined.startswith("osd df"):
+        return {"prefix": "osd df"}
     if joined.startswith("osd pool create"):
         rest = args[3:]
         cmd = {"prefix": "osd pool create", "pool": rest[0]}
@@ -247,6 +300,22 @@ def main(argv=None) -> int:
                     MScrubCommand(
                         tid=msgr.new_tid(),
                         op=target["op"], pgid=target["pgid"],
+                    )
+                )
+        elif prefix == "tell":
+            # mon names the daemon's address; the CLI dispatches the
+            # inner command there as an MCommand (`ceph tell` route)
+            reply = mc.command(cmd)
+            if reply.rc == 0 and reply.outb:
+                from ..msg.message import MCommand
+
+                target = json.loads(reply.outb)
+                host, _, port = target["addr"].rpartition(":")
+                conn = msgr.connect(host, int(port))
+                reply = conn.call(
+                    MCommand(
+                        tid=msgr.new_tid(),
+                        cmd=json.dumps(target["args"]),
                     )
                 )
         else:

@@ -44,7 +44,7 @@ def _sample_messages():
             pool=7, pgid="7.3", oid="obj-1", op=M.OSD_OP_WRITE,
             offset=4096, length=11, data=b"hello world",
             attr="k", reqid="client.9", epoch=42, snapid=5,
-            snap_seq=6,
+            snap_seq=6, flags=M.OSD_FLAG_FULL_TRY,
         ),
         "MOSDOpReply": M.MOSDOpReply(
             ok=True, error="", data=b"payload", names=["a", "b"],
@@ -86,6 +86,13 @@ def _sample_messages():
         ),
         "MScrubCommand": M.MScrubCommand(
             op="deep-scrub", pgid="1.3"
+        ),
+        "MOSDBackoff": M.MOSDBackoff(
+            op=M.BACKOFF_OP_BLOCK, pgid="7.3", id=4,
+            reason="full", epoch=42,
+        ),
+        "MCommand": M.MCommand(
+            cmd='{"prefix": "fault list"}'
         ),
     }
     for name, msg in samples.items():

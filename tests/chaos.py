@@ -1,0 +1,576 @@
+"""Chaos scenario driver — composes the fault-injection plane
+(msg/faults.py), the RADOS backoff protocol (MOSDBackoff), and
+full-space degradation into whole-cluster failure-weather runs (the
+qa/tasks netem/partition thrashers' role, in-process and
+deterministic).
+
+Each scenario builds its own live mini-cluster over real messengers,
+injects the weather, asserts the survival properties from ISSUE 5's
+acceptance criteria, and tears everything down:
+
+- ``scenario_mon_netsplit``       majority/minority monitor split:
+  the minority mon stops serving, the majority keeps committing, and
+  after heal the cluster converges with zero acknowledged-write loss.
+- ``scenario_asymmetric_partition``  a one-directional OSD link break
+  under client load: the ``mon_osd_min_down_reporters`` flap guard
+  keeps the reachable OSD up, and replicas re-converge after heal.
+- ``scenario_lossy_link``         delay+jitter+duplication on the
+  client→OSD path: every write lands exactly once (session/reqid
+  dedup), and the injector's decision stream is byte-identical when
+  the run repeats under the same seed.
+- ``scenario_fill_to_full``       write until the store crosses
+  ``mon_osd_full_ratio``: further writes park on MOSDBackoff (visible
+  in dump_backoffs on both ends, no resend storm), OSD_FULL raises
+  HEALTH_ERR, reads keep serving, FULL_TRY deletes land, and freeing
+  space releases the parked ops and clears the check.
+
+pytest drives these from tests/test_chaos.py (multi-second scenarios
+carry the ``slow`` marker there); ``python tests/chaos.py [name ...]``
+runs them standalone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ceph_tpu.msg.messenger import wait_for  # noqa: E402
+from ceph_tpu.osd.daemon import OSD  # noqa: E402
+from ceph_tpu.rados import Rados, RadosError  # noqa: E402
+
+DEFAULT_SEED = 20260803
+
+
+# -- plumbing ---------------------------------------------------------------
+def addr_str(addr) -> str:
+    host, port = addr
+    return f"{host}:{port}"
+
+
+def install_aliases(messengers, aliases: dict[str, str]) -> None:
+    """Teach every injector the daemon-name → address map so rules
+    and partitions can say ``osd.1`` / ``mon.2``."""
+    for m in messengers:
+        for name, addr in aliases.items():
+            m.faults.alias(name, addr)
+
+
+def install_partition(
+    messengers, groups, aliases, name="netsplit", seed=DEFAULT_SEED
+) -> None:
+    """One symmetric netsplit: the same named partition (and seed) on
+    every member messenger."""
+    for m in messengers:
+        m.faults.reseed(seed)
+    install_aliases(messengers, aliases)
+    for m in messengers:
+        m.faults.set_partition(name, groups)
+
+
+def heal(messengers, name: str | None = None) -> None:
+    for m in messengers:
+        if name is not None:
+            m.faults.clear_partition(name)
+        else:
+            m.faults.clear()
+
+
+def fault_counters(messenger) -> dict:
+    return messenger.faults.perf.dump()
+
+
+# -- scenario 1: majority/minority monitor netsplit -------------------------
+def scenario_mon_netsplit(seed: int = DEFAULT_SEED) -> dict:
+    from test_paxos import N_OSD, MonCluster
+
+    c = MonCluster()
+    osds: dict[int, OSD] = {}
+    client = minority_client = None
+    try:
+        leader = c.wait_quorum()
+        # the minority is one peon; majority = leader + other peon
+        minority = max(r for r in c.mons if r != leader.rank)
+        majority = sorted(r for r in c.mons if r != minority)
+        for i in range(N_OSD):
+            osd = OSD(i, tick_interval=0.2, heartbeat_grace=1.0)
+            osd.boot(mon_addrs=[c.monmap.addrs[r] for r in majority])
+            osds[i] = osd
+        assert wait_for(
+            lambda: all(
+                leader.osdmap.is_up(o) for o in range(N_OSD)
+            ),
+            10.0,
+        ), "OSDs never booted"
+        client = Rados("chaos-split").connect_any(
+            [c.monmap.addrs[r] for r in majority]
+        )
+        client.pool_create("splitpool", pg_num=2, size=3)
+        io = client.open_ioctx("splitpool")
+        io.write_full("pre", b"before-split")
+        minority_client = Rados("chaos-minority").connect(
+            *c.monmap.addrs[minority]
+        )
+
+        aliases = {
+            f"mon.{r}": addr_str(a)
+            for r, a in c.monmap.addrs.items()
+        }
+        groups = [
+            [f"mon.{r}" for r in majority],
+            [f"mon.{minority}"],
+        ]
+        mon_msgrs = [m.messenger for m in c.mons.values()]
+        install_partition(
+            mon_msgrs, groups, aliases, name="netsplit", seed=seed
+        )
+
+        # minority drops out of quorum once its lease dies
+        assert wait_for(
+            lambda: not c.mons[minority].in_quorum, 15.0
+        ), "minority mon never left quorum"
+        # ... and stops serving: commands EAGAIN instead of lying
+        reply = minority_client.monc.command(
+            {"prefix": "osd pool ls"}, timeout=2.5
+        )
+        assert reply.rc == -11, (
+            f"minority mon still serving: rc={reply.rc}"
+        )
+
+        # majority keeps committing: client load + a map-bumping
+        # command, all through majority monitors
+        acked: dict[str, bytes] = {}
+        for k in range(8):
+            data = bytes([k + 1]) * 700
+            io.write_full(f"during-{k}", data)
+            acked[f"during-{k}"] = data
+        reply = client.monc.command(
+            {
+                "prefix": "osd pool create",
+                "pool": "during-pool", "pg_num": 2,
+            }
+        )
+        assert reply.rc == 0, reply.outs
+        committed_epoch = json.loads(reply.outb)["epoch"]
+        assert (
+            "during-pool"
+            not in c.mons[minority].osdmap.pool_names.values()
+        ), "minority saw a commit across the netsplit"
+        dropped = sum(
+            fault_counters(m)["fault_dropped"] for m in mon_msgrs
+        )
+        assert dropped > 0, "netsplit never dropped a frame"
+        # every member logged only partition verdicts — the seeded
+        # stream is untouched, so the run replays byte-identically
+        decisions = {
+            m.name: [what for (_dst, what) in m.faults.decisions]
+            for m in mon_msgrs
+        }
+        assert all(
+            what == "partition-drop"
+            for log in decisions.values()
+            for what in log
+        )
+
+        heal(mon_msgrs, "netsplit")
+        c.wait_quorum()
+        assert wait_for(
+            lambda: all(
+                m.osdmap.epoch >= committed_epoch
+                and "during-pool" in m.osdmap.pool_names.values()
+                for m in c.mons.values()
+            ),
+            15.0,
+        ), "minority never converged after heal"
+        # zero acknowledged-write loss
+        assert io.read("pre") == b"before-split"
+        for oid, data in sorted(acked.items()):
+            assert io.read(oid) == data, f"acked write {oid} lost"
+        return {
+            "seed": seed,
+            "minority": minority,
+            "dropped": dropped,
+            "acked_writes": len(acked) + 1,
+            "final_epoch": max(
+                m.osdmap.epoch for m in c.mons.values()
+            ),
+        }
+    finally:
+        for cl in (client, minority_client):
+            if cl is not None:
+                cl.shutdown()
+        for osd in osds.values():
+            osd.shutdown()
+        c.shutdown()
+
+
+# -- scenario 2: asymmetric OSD partition under client load -----------------
+def scenario_asymmetric_partition(seed: int = DEFAULT_SEED) -> dict:
+    from test_osd_daemon import MiniCluster
+
+    c = MiniCluster()
+    client = None
+    try:
+        stores = {}
+        for i in range(3):
+            osd = c.start_osd(i)
+            osd.repop_timeout = 1.5  # fail fast across the break
+            stores[i] = osd.store
+        c.wait_active()
+        # flap guard: one live reporter must NOT down a reachable OSD
+        c.mon.config_db.setdefault("mon", {})[
+            "mon_osd_min_down_reporters"
+        ] = "2"
+        client = Rados("chaos-asym").connect(*c.mon_addr)
+        client.pool_create("asympool", pg_num=2, size=3)
+        io = client.open_ioctx("asympool")
+        client.objecter.op_timeout = 30.0
+        io.write_full("seed", b"s")
+
+        stop = threading.Event()
+        written: dict[str, bytes] = {}
+        wlock = threading.Lock()
+        mismatches: list[str] = []
+
+        def load():
+            i = 0
+            while not stop.is_set():
+                oid = f"a{i % 12}"
+                data = bytes([1 + i % 255]) * (80 + (i % 4) * 90)
+                try:
+                    io.write_full(oid, data)
+                    with wlock:
+                        written[oid] = data
+                    got = io.read(oid)
+                    if got != data:
+                        mismatches.append(oid)
+                except RadosError:
+                    pass  # inside the break window; retried later
+                i += 1
+                time.sleep(0.05)
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        time.sleep(0.6)
+
+        # one-way break: every frame osd.1 sends toward osd.2
+        # vanishes; osd.2 → osd.1 still flows
+        m1 = c.osds[1].messenger
+        m1.faults.reseed(seed)
+        m1.faults.alias("osd.2", addr_str(c.osds[2].addr))
+        m1.faults.add_rule(dst="osd.2", drop=1.0)
+
+        flapped = []
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 4.0:
+            osdmap = client.monc.osdmap
+            for o in (1, 2):
+                if not osdmap.is_up(o):
+                    flapped.append(o)
+            time.sleep(0.2)
+        assert not flapped, (
+            f"flap guard failed: osds {sorted(set(flapped))} "
+            "were marked down by a single partitioned reporter"
+        )
+        # both sides really reported the other (the aggregator held)
+        pending = {
+            tgt: sorted(p.reporters)
+            for tgt, p in c.mon.failures._pending.items()
+        }
+        dropped = fault_counters(m1)["fault_dropped"]
+        assert dropped > 0, "asymmetric rule never dropped a frame"
+
+        m1.faults.clear()
+        time.sleep(1.0)  # let in-flight retries land
+        stop.set()
+        t.join(timeout=15)
+        assert not mismatches, f"acked writes misread: {mismatches}"
+        assert written, "load thread never completed a write"
+        for oid, data in sorted(written.items()):
+            assert io.read(oid) == data, f"acked write {oid} lost"
+
+        from ceph_tpu.osd.daemon import OBJ_PREFIX
+
+        pool_id = client.pool_lookup("asympool")
+
+        def replicas_agree():
+            for oid, data in written.items():
+                copies = []
+                for osd in c.osds.values():
+                    for pg in osd.pgs.values():
+                        if pg.pool_id != pool_id:
+                            continue
+                        try:
+                            copies.append(
+                                osd.store.read(
+                                    pg.cid, OBJ_PREFIX + oid
+                                )
+                            )
+                        except Exception:  # noqa: BLE001
+                            pass
+                if len(copies) != 3 or any(
+                    cp != data for cp in copies
+                ):
+                    return False
+            return True
+
+        assert wait_for(replicas_agree, 25.0), (
+            "replicas diverged after heal"
+        )
+        return {
+            "seed": seed,
+            "dropped": dropped,
+            "acked_writes": len(written),
+            "failure_reports_held": pending,
+        }
+    finally:
+        if client is not None:
+            client.shutdown()
+        c.shutdown()
+
+
+# -- scenario 3: lossy-link recovery + deterministic replay -----------------
+def _lossy_run(seed: int, n_ops: int = 12):
+    """One synchronous client run under delay+jitter+dup toward every
+    OSD; returns (decision stream, fault counters).  Synchronous ops
+    + a seeded stream make the whole run replay-identical."""
+    from test_osd_daemon import MiniCluster
+
+    c = MiniCluster()
+    client = None
+    try:
+        for i in range(3):
+            c.start_osd(i)
+        c.wait_active()
+        client = Rados("chaos-lossy").connect(*c.mon_addr)
+        client.pool_create("lossypool", pg_num=2, size=3)
+        io = client.open_ioctx("lossypool")
+
+        cm = client.messenger
+        cm.faults.reseed(seed)
+        for i, osd in c.osds.items():
+            cm.faults.alias(f"osd.{i}", addr_str(osd.addr))
+        # no drops: nothing times out, so the send sequence is a pure
+        # function of the op sequence and the trace replays exactly
+        for i in range(3):
+            cm.faults.add_rule(
+                dst=f"osd.{i}", delay=0.02, jitter=0.03, dup=0.4
+            )
+        for k in range(n_ops):
+            io.write_full(f"lossy-{k}", bytes([k + 1]) * 600)
+        for k in range(n_ops):
+            assert io.read(f"lossy-{k}") == bytes([k + 1]) * 600
+        counters = fault_counters(cm)
+        # identity-free decision stream (ports differ across runs)
+        stream = [what for (_dst, what) in cm.faults.decisions]
+        return stream, counters
+    finally:
+        if client is not None:
+            client.shutdown()
+        c.shutdown()
+
+
+def scenario_lossy_link(seed: int = DEFAULT_SEED) -> dict:
+    stream_a, counters = _lossy_run(seed)
+    assert counters["fault_delayed"] > 0, "no frame was delayed"
+    assert counters["fault_duplicated"] > 0, "no frame was duplicated"
+    # byte-reproducible: the identical run under the identical seed
+    # makes the identical decisions, verdict for verdict
+    stream_b, counters_b = _lossy_run(seed)
+    assert stream_a == stream_b, (
+        "seeded chaos run was not reproducible:\n"
+        f"  a={stream_a}\n  b={stream_b}"
+    )
+    assert counters == counters_b
+    # ... and a different seed really changes the weather
+    stream_c, _ = _lossy_run(seed + 1)
+    assert stream_a != stream_c, (
+        "decision stream ignored the seed"
+    )
+    return {
+        "seed": seed,
+        "decisions": len(stream_a),
+        "delayed": counters["fault_delayed"],
+        "duplicated": counters["fault_duplicated"],
+    }
+
+
+# -- scenario 4: fill to full, then delete ----------------------------------
+def scenario_fill_to_full(seed: int = DEFAULT_SEED) -> dict:
+    from test_osd_daemon import MiniCluster
+
+    cap = 192 * 1024
+    obj = 16 * 1024
+    c = MiniCluster()
+    client = None
+    try:
+        for i in range(3):
+            osd = c.start_osd(i)
+            osd.store.total_bytes = cap
+        c.wait_active()
+        client = Rados("chaos-full").connect(*c.mon_addr)
+        client.objecter.op_timeout = 30.0
+        client.pool_create("fullpool", pg_num=2, size=3)
+        io = client.open_ioctx("fullpool")
+
+        # fill: size-3 pool on equal stores fills all three together
+        full_ratio = 0.95
+        filled = []
+        for k in range(64):
+            stats = c.osds[0].store.statfs()
+            if (stats["used"] + obj) / stats["total"] >= full_ratio:
+                break
+            io.write_full(f"fill-{k}", bytes([k + 1]) * obj)
+            filled.append(f"fill-{k}")
+        assert len(filled) >= 4, "store too small to stage the fill"
+        # push every store over the line with one last FULL_TRY write
+        io.remove(filled.pop(), full_try=True)
+        io.write_full(f"fill-top", bytes([99]) * (2 * obj))
+        filled.append("fill-top")
+        assert wait_for(
+            lambda: all(
+                o._check_full() for o in c.osds.values()
+            ),
+            5.0,
+        ), "stores never crossed mon_osd_full_ratio"
+
+        # OSD_FULL raises HEALTH_ERR off the ~1 Hz stat reports
+        def health():
+            reply = c.mon.handle_command(json.dumps(
+                {"prefix": "health"}
+            ))
+            return json.loads(reply.outb)
+
+        assert wait_for(
+            lambda: "OSD_FULL" in health()["checks_detail"], 6.0
+        ), f"OSD_FULL never raised: {health()}"
+        h = health()
+        assert h["status"] == "HEALTH_ERR", h
+        assert (
+            h["checks_detail"]["OSD_FULL"]["severity"]
+            == "HEALTH_ERR"
+        )
+
+        # reads keep serving on a full cluster
+        assert io.read(filled[0]) == bytes([1]) * obj
+
+        # a plain write parks on MOSDBackoff instead of resending
+        parked_done = threading.Event()
+        parked_err: list[str] = []
+
+        def parked_write():
+            try:
+                io.write_full("parked", b"p" * obj)
+            except RadosError as e:  # pragma: no cover - assertion aid
+                parked_err.append(str(e))
+            finally:
+                parked_done.set()
+
+        t = threading.Thread(target=parked_write, daemon=True)
+        t.start()
+        assert wait_for(
+            lambda: client.objecter.dump_backoffs(), 10.0
+        ), "objecter never parked the write"
+        client_view = client.objecter.dump_backoffs()
+        assert client_view[0]["reason"] == "full", client_view
+        osd_views = {
+            i: o.dump_backoffs() for i, o in c.osds.items()
+        }
+        assert any(
+            b["reason"] == "full"
+            for views in osd_views.values()
+            for b in views
+        ), f"no OSD holds the backoff: {osd_views}"
+
+        # no resend storm: while parked, the primary sees no new ops
+        # for it (the op counter stays flat across a full second)
+        ops_before = sum(
+            o.perf.dump()["op"] for o in c.osds.values()
+        )
+        time.sleep(1.0)
+        ops_after = sum(
+            o.perf.dump()["op"] for o in c.osds.values()
+        )
+        assert not parked_done.is_set(), "parked write completed full"
+        assert ops_after - ops_before <= 1, (
+            f"resend storm while parked: {ops_after - ops_before} "
+            "ops in 1s"
+        )
+
+        # FULL_TRY deletes still land and free space
+        for oid in filled[: len(filled) // 2 + 2]:
+            io.remove(oid, full_try=True)
+            filled.remove(oid)
+        # ... which releases the parked op and clears the check
+        assert parked_done.wait(15.0), (
+            "parked write never released after space freed"
+        )
+        assert not parked_err, parked_err
+        assert io.read("parked") == b"p" * obj
+        assert wait_for(
+            lambda: not client.objecter.dump_backoffs()
+            and not any(o.dump_backoffs() for o in c.osds.values()),
+            10.0,
+        ), "backoffs never drained"
+        assert wait_for(
+            lambda: "OSD_FULL" not in health()["checks_detail"],
+            10.0,
+        ), f"OSD_FULL never cleared: {health()}"
+        for oid in filled:
+            assert io.read(oid).startswith(
+                bytes([int(oid.split("-")[1]) + 1])
+                if oid != "fill-top" else bytes([99])
+            )
+        return {
+            "seed": seed,
+            "filled": len(filled),
+            "parked_released": True,
+            "final_health": health()["status"],
+        }
+    finally:
+        if client is not None:
+            client.shutdown()
+        c.shutdown()
+
+
+SCENARIOS = {
+    "mon_netsplit": scenario_mon_netsplit,
+    "asymmetric_partition": scenario_asymmetric_partition,
+    "lossy_link": scenario_lossy_link,
+    "fill_to_full": scenario_fill_to_full,
+}
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="chaos", description=__doc__,
+    )
+    p.add_argument(
+        "scenario", nargs="*", choices=[*SCENARIOS, []],
+        help="scenarios to run (default: all)",
+    )
+    p.add_argument("--seed", type=int, default=DEFAULT_SEED)
+    args = p.parse_args(argv)
+    names = args.scenario or list(SCENARIOS)
+    rc = 0
+    for name in names:
+        t0 = time.monotonic()
+        try:
+            result = SCENARIOS[name](seed=args.seed)
+        except AssertionError as e:
+            print(f"chaos {name}: FAIL — {e}", file=sys.stderr)
+            rc = 1
+            continue
+        dt = time.monotonic() - t0
+        print(f"chaos {name}: ok in {dt:.1f}s {json.dumps(result)}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

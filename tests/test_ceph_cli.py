@@ -10,6 +10,8 @@ import pytest
 
 from ceph_tpu.tools.ceph_cli import _build_command, main
 
+from ceph_tpu.msg.messenger import wait_for
+
 from test_osd_daemon import MiniCluster
 
 
@@ -128,3 +130,58 @@ def test_round5_command_translations():
         "prefix": "osd pool set", "pool": "p", "var": "pg_num",
         "val": "8",
     }
+
+
+def _run_cli_subprocess(mon, *words):
+    """Drive the CLI like production does — its own process (its own
+    event loops; the in-process harness interleaves three messengers'
+    teardown and flakes on cross-loop noise)."""
+    import subprocess
+    import sys
+
+    p = subprocess.run(
+        [
+            sys.executable, "-m", "ceph_tpu.tools.ceph_cli",
+            "-m", f"{mon.mon_addr[0]}:{mon.mon_addr[1]}",
+            "-f", "json", *words,
+        ],
+        capture_output=True, text=True, timeout=60,
+    )
+    return p.returncode, p.stdout
+
+
+def test_tell_fault_route_against_live_osd(mon):
+    """`ceph tell osd.N fault ...` (ISSUE 5): the mon names the
+    daemon's address, the CLI dispatches the inner command there as
+    an MCommand, and the injector answers — rules install, list,
+    and clear over the wire; dump_backoffs serves too."""
+    osd = mon.start_osd(0)
+    assert wait_for(lambda: mon.monc.osdmap.is_up(0), 10.0)
+
+    rc, out = _run_cli_subprocess(
+        mon, "tell", "osd.0", "fault", "set", "dst=osd.1",
+        "drop=0.25", "delay=0.01",
+    )
+    assert rc == 0, out
+    rule_id = json.loads(out)["rule_id"]
+    # the rule really landed on the daemon's injector
+    listed = osd.messenger.faults.list_rules()
+    assert [r["id"] for r in listed["rules"]] == [rule_id]
+    assert listed["rules"][0]["drop"] == 0.25
+
+    rc, out = _run_cli_subprocess(mon, "tell", "osd.0", "fault", "list")
+    assert rc == 0
+    assert json.loads(out)["rules"][0]["dst"] == "osd.1"
+
+    rc, out = _run_cli_subprocess(mon, "tell", "osd.0", "dump_backoffs")
+    assert rc == 0 and json.loads(out) == []
+
+    rc, out = _run_cli_subprocess(
+        mon, "tell", "osd.0", "fault", "clear", f"id={rule_id}",
+    )
+    assert rc == 0 and json.loads(out)["cleared"] == 1
+    assert not osd.messenger.faults.active
+
+    # a tell at a down/unknown osd is rejected by the mon
+    rc, out = _run_cli_subprocess(mon, "tell", "osd.9", "fault", "list")
+    assert rc != 0

@@ -1,0 +1,278 @@
+"""Fault-injection plane + RADOS backoff protocol + full-space
+degradation (ISSUE 5): fast injector/backoff units in tier-1, the
+whole-cluster chaos scenarios from tests/chaos.py behind ``slow``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import chaos
+from ceph_tpu.msg.faults import FaultInjector
+from ceph_tpu.msg.messenger import wait_for
+from ceph_tpu.rados import Rados
+from ceph_tpu.tools.ceph_cli import _build_command, _build_tell_args
+
+from test_osd_daemon import MiniCluster
+
+
+class _StubConn:
+    def __init__(self, label=None):
+        self.peer_label = label
+
+
+# -- injector units ---------------------------------------------------------
+def test_injector_deterministic_replay():
+    """Same seed + same send sequence → identical verdicts, counters,
+    and decision log; a different seed changes the weather."""
+
+    def run(seed):
+        f = FaultInjector("osd.0", seed=seed)
+        f.alias("osd.1", "127.0.0.1:7001")
+        f.add_rule(
+            dst="osd.1", drop=0.3, delay=0.01, jitter=0.05, dup=0.3,
+            reorder=0.2,
+        )
+        f.add_rule(drop=0.05)  # wildcard riding the same stream
+        conns = [_StubConn("127.0.0.1:7001"), _StubConn("mon-addr")]
+        acts = [
+            (a.drop, round(a.delay, 9), a.duplicate)
+            for a in (
+                f.plan(conns[i % 2]) for i in range(200)
+            )
+        ]
+        return acts, f.perf.dump(), list(f.decisions)
+
+    a1 = run(42)
+    a2 = run(42)
+    assert a1 == a2
+    b = run(43)
+    assert a1[0] != b[0]
+
+
+def test_injector_partition_groups():
+    """A netsplit in one call: frames crossing group boundaries drop,
+    intra-group traffic flows, and clearing the partition heals."""
+    f = FaultInjector("mon.0", seed=1)
+    f.alias("mon.1", "h:1")
+    f.alias("mon.2", "h:2")
+    f.set_partition("split", [["mon.0", "mon.1"], ["mon.2"]])
+    same_side = _StubConn("h:1")
+    far_side = _StubConn("h:2")
+    assert not f.plan(same_side).drop
+    assert f.plan(far_side).drop
+    # an unlabeled connection (accepted, never stamped) is never
+    # partition-dropped — fail open, not closed
+    assert not f.plan(_StubConn()).drop
+    assert f.perf.dump()["fault_dropped"] == 1
+    assert f.clear_partition("split") == 1
+    assert not f.plan(far_side).drop
+    # a member NOT in any group sees no effect
+    g = FaultInjector("client", seed=1)
+    g.alias("mon.2", "h:2")
+    g.set_partition("split", [["mon.0", "mon.1"], ["mon.2"]])
+    assert not g.plan(_StubConn("h:2")).drop
+
+
+def test_injector_socket_failure_per_connection():
+    """The legacy every-Nth knob fires per CONNECTION: a second
+    connection's sends can no longer skip or double-fire the first
+    connection's injection window (the shared-counter bug)."""
+    f = FaultInjector("osd.0", seed=0)
+    f.socket_failure_every = 3
+    a, b = _StubConn("x"), _StubConn("y")
+    fires = []
+    # interleave: each connection must fire on ITS OWN 3rd/6th send
+    for i in range(12):
+        conn = a if i % 2 == 0 else b
+        if f.plan(conn).sockfail:
+            fires.append((conn is a, getattr(conn, "_sockfail_count")))
+    assert fires == [(True, 3), (False, 3), (True, 6), (False, 6)]
+    assert f.perf.dump()["fault_socket_failures"] == 4
+
+
+def test_injector_command_surface():
+    """The `fault set/clear/list/seed` dict grammar the admin socket
+    and `ceph tell` both route."""
+    f = FaultInjector("osd.3", seed=9)
+    out = f.command(
+        {"op": "set", "dst": "osd.1", "drop": 0.5, "delay": 0.01}
+    )
+    rid = out["rule_id"]
+    out = f.command(
+        {
+            "op": "set", "partition": "split",
+            "groups": [["osd.3"], ["osd.1"]],
+        }
+    )
+    assert out == {"partition": "split"}
+    listed = f.command({"op": "list"})
+    assert listed["seed"] == 9
+    assert [r["id"] for r in listed["rules"]] == [rid]
+    assert listed["partitions"] == {"split": [["osd.3"], ["osd.1"]]}
+    assert f.command({"op": "seed", "seed": 4})["seed"] == 4
+    assert f.command({"op": "clear", "id": rid})["cleared"] == 1
+    assert f.command({"op": "clear"})["cleared"] == 1  # partition
+    assert not f.active
+    with pytest.raises(ValueError):
+        f.command({"op": "set", "partition": "bad", "groups": "x"})
+    with pytest.raises(ValueError):
+        f.command({"op": "bogus"})
+
+
+def test_legacy_socket_failure_knob_routes_to_injector():
+    """Messenger.inject_socket_failures is now a view over the
+    injector — both fault paths share one code path and counter."""
+    from ceph_tpu.msg import Messenger
+
+    m = Messenger("legacy-knob")
+    try:
+        m.inject_socket_failures = 5
+        assert m.faults.socket_failure_every == 5
+        assert m.inject_socket_failures == 5
+        m.inject_socket_failures = 0
+        assert not m.faults.active
+    finally:
+        m.shutdown()
+
+
+def test_cli_tell_grammar():
+    """`ceph tell osd.N fault ...` argv → mon `tell` envelope with the
+    inner daemon command."""
+    cmd = _build_command(
+        ["tell", "osd.1", "fault", "set", "dst=osd.2", "drop=0.5",
+         "delay=0.01"]
+    )
+    assert cmd["prefix"] == "tell"
+    assert cmd["target"] == "osd.1"
+    assert cmd["args"] == {
+        "prefix": "fault set", "dst": "osd.2", "drop": 0.5,
+        "delay": 0.01,
+    }
+    cmd = _build_tell_args(
+        ["fault", "set", "partition=split", "groups=osd.0,osd.1;osd.2"]
+    )
+    assert cmd["groups"] == [["osd.0", "osd.1"], ["osd.2"]]
+    assert _build_tell_args(["fault", "seed", "7"]) == {
+        "prefix": "fault seed", "seed": 7,
+    }
+    assert _build_tell_args(["dump_backoffs"]) == {
+        "prefix": "dump_backoffs"
+    }
+
+
+# -- backoff protocol (the satellite Objecter test) -------------------------
+def test_objecter_parks_on_backoff_and_completes_after_unblock():
+    """A write to a full OSD parks on MOSDBackoff — visible in
+    dump_backoffs on both ends, no resends while parked — and
+    COMPLETES once the OSD unblocks, instead of timing out."""
+    c = MiniCluster()
+    client = None
+    try:
+        for i in range(3):
+            c.start_osd(i)
+        c.wait_active()
+        client = Rados("backoff-park").connect(*c.mon_addr)
+        client.objecter.op_timeout = 20.0
+        client.pool_create("parkpool", pg_num=2, size=3)
+        io = client.open_ioctx("parkpool")
+        io.write_full("warm", b"w" * 4096)
+
+        # the mon's RUNTIME full ratio reaches the OSD write gate via
+        # the stat-report reply (no divergence between the health
+        # check and actual blocking)
+        c.mon.config_db.setdefault("mon", {})[
+            "mon_osd_full_ratio"
+        ] = "0.5"
+        assert wait_for(
+            lambda: all(
+                o._mon_full_ratio == 0.5 for o in c.osds.values()
+            ),
+            6.0,
+        ), "runtime mon_osd_full_ratio never reached the OSDs"
+
+        # make every store instantly "full" (statfs total shrinks
+        # under the bytes already written) — and wait out the ~0.5s
+        # statfs cache so the primaries have all noticed
+        for osd in c.osds.values():
+            osd.store.total_bytes = 1024
+        assert wait_for(
+            lambda: all(o._check_full() for o in c.osds.values()),
+            5.0,
+        )
+
+        done = threading.Event()
+        err: list[str] = []
+
+        def blocked_write():
+            try:
+                io.write_full("parked", b"p" * 2048)
+            except Exception as e:  # noqa: BLE001
+                err.append(str(e))
+            finally:
+                done.set()
+
+        t = threading.Thread(target=blocked_write, daemon=True)
+        t.start()
+        assert wait_for(
+            lambda: client.objecter.dump_backoffs(), 10.0
+        ), "objecter never parked"
+        parked = client.objecter.dump_backoffs()[0]
+        assert parked["reason"] == "full"
+        assert client.objecter.backoff_parks >= 1
+        assert any(
+            b["reason"] == "full"
+            for o in c.osds.values()
+            for b in o.dump_backoffs()
+        ), "no OSD holds the block backoff"
+        # parked means PARKED: no resends hit the primaries
+        ops0 = sum(o.perf.dump()["op"] for o in c.osds.values())
+        time.sleep(0.8)
+        assert (
+            sum(o.perf.dump()["op"] for o in c.osds.values()) - ops0
+            <= 1
+        ), "op resent while parked on backoff"
+        assert not done.is_set()
+
+        # space "frees" → the OSD tick sends unblock → op completes
+        for osd in c.osds.values():
+            osd.store.total_bytes = 1 << 30
+        assert done.wait(10.0), "parked op never released"
+        assert not err, err
+        assert io.read("parked") == b"p" * 2048
+        assert wait_for(
+            lambda: not client.objecter.dump_backoffs(), 5.0
+        )
+        # reads served fine the whole time — and the fullness gauges
+        # made it into the perf dump the mgr report ships
+        dump = c.osds[0].perf.dump()
+        assert dump["stat_bytes"] > 0
+        assert "backoffs_active" in dump
+    finally:
+        if client is not None:
+            client.shutdown()
+        c.shutdown()
+
+
+# -- whole-cluster chaos scenarios (tests/chaos.py driver) ------------------
+@pytest.mark.slow
+def test_scenario_mon_netsplit():
+    chaos.scenario_mon_netsplit()
+
+
+@pytest.mark.slow
+def test_scenario_asymmetric_partition():
+    chaos.scenario_asymmetric_partition()
+
+
+@pytest.mark.slow
+def test_scenario_lossy_link():
+    chaos.scenario_lossy_link()
+
+
+@pytest.mark.slow
+def test_scenario_fill_to_full():
+    chaos.scenario_fill_to_full()

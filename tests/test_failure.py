@@ -131,3 +131,46 @@ def test_externally_downed_target_clears_pending():
     om.mark_down(3)
     agg.report_failure(3, reporter=1, now=2.0)
     assert agg.pending_reports() == {}
+
+
+def test_min_down_reporters_flap_guard():
+    """mon_osd_min_down_reporters (ISSUE 5 satellite): the threshold
+    is a zero-arg callable read per report, so `ceph config set mon
+    mon_osd_min_down_reporters N` raises the bar at runtime — one
+    partitioned reporter can no longer re-down a reachable OSD."""
+    om = _cluster()
+    config = {"mon_osd_min_down_reporters": 1}
+    agg = FailureAggregator(
+        om,
+        min_reporters=lambda: config["mon_osd_min_down_reporters"],
+    )
+    # default 1: a single reporter still tips (existing behavior)
+    assert agg.report_failure(4, reporter=0, now=1.0)
+    assert not om.is_up(4)
+
+    # the operator raises the bar; the SAME aggregator now requires
+    # two distinct live reporters
+    config["mon_osd_min_down_reporters"] = 2
+    assert not agg.report_failure(3, reporter=0, now=2.0)
+    assert om.is_up(3)
+    # the flapping single reporter re-reports — still not enough
+    assert not agg.report_failure(3, reporter=0, now=3.0)
+    assert om.is_up(3)
+    assert agg.report_failure(3, reporter=1, now=4.0)  # 2nd tips
+    assert not om.is_up(3)
+
+
+def test_monitor_min_down_reporters_reads_config_db():
+    """The Monitor threads its centralized config into the aggregator
+    (constructor value stays the fallback)."""
+    from ceph_tpu.mon.monitor import Monitor
+
+    mon = Monitor(_cluster(), min_reporters=2)
+    assert mon.min_down_reporters() == 2  # constructor fallback
+    mon.config_db.setdefault("mon", {})[
+        "mon_osd_min_down_reporters"
+    ] = "3"
+    assert mon.min_down_reporters() == 3
+    assert mon.failures._threshold() == 3
+    mon.config_db["mon"]["mon_osd_min_down_reporters"] = "bogus"
+    assert mon.min_down_reporters() == 2  # unparseable → fallback

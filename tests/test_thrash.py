@@ -8,6 +8,8 @@ import random
 import threading
 import time
 
+import pytest
+
 from ceph_tpu.msg.messenger import wait_for
 from ceph_tpu.osd.daemon import OBJ_PREFIX
 from ceph_tpu.rados import Rados, RadosError
@@ -104,4 +106,93 @@ def test_thrash_kills_revives_under_load():
         assert wait_for(replicas_agree, 25.0), "replicas diverged"
     finally:
         client.shutdown()
+        c.shutdown()
+
+
+@pytest.mark.slow
+def test_thrash_mon_peon_kill_revive_under_load():
+    """Mon thrash (ISSUE 5 satellite): a peon dies and revives
+    mid-thrash — quorum survives throughout (2/3 majority), client
+    load keeps landing, and the revived peon catches back up."""
+    from test_paxos import MonCluster
+
+    from ceph_tpu.osd.daemon import OSD
+
+    c = MonCluster()
+    osds: dict[int, OSD] = {}
+    client = None
+    stop = threading.Event()
+    try:
+        leader = c.wait_quorum()
+        for i in range(3):
+            o = OSD(i, tick_interval=0.2, heartbeat_grace=1.0)
+            o.boot(mon_addrs=c.addrs())
+            osds[i] = o
+        assert wait_for(
+            lambda: all(leader.osdmap.is_up(o) for o in range(3)),
+            10.0,
+        )
+        client = Rados("mon-thrash").connect_any(c.addrs())
+        client.objecter.op_timeout = 30.0
+        client.pool_create("monthrash", pg_num=2, size=3)
+        io = client.open_ioctx("monthrash")
+
+        written: dict[str, bytes] = {}
+        wlock = threading.Lock()
+        errors: list[str] = []
+
+        def load():
+            i = 0
+            while not stop.is_set():
+                oid = f"m{i % 16}"
+                data = bytes([1 + i % 255]) * (100 + (i % 3) * 80)
+                try:
+                    io.write_full(oid, data)
+                    with wlock:
+                        written[oid] = data
+                    if io.read(oid) != data:
+                        errors.append(f"{oid} misread")
+                except RadosError:
+                    pass
+                i += 1
+                time.sleep(0.03)
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        time.sleep(0.5)
+
+        # kill a PEON (quorum survives on 2/3) and thrash it twice
+        for _cycle in range(2):
+            leader = c.wait_quorum()
+            peon = max(r for r in c.mons if r != leader.rank)
+            c.kill_mon(peon)
+            # the surviving majority still serves: a mon command and
+            # client writes both land while the peon is down
+            reply = client.monc.command({"prefix": "osd pool ls"})
+            assert reply.rc == 0
+            time.sleep(1.0)
+            c.start_mon(peon)
+            c.wait_quorum()
+
+        stop.set()
+        t.join(timeout=15)
+        assert not errors, errors
+        assert written, "load thread never completed a write"
+        for oid, data in sorted(written.items()):
+            assert io.read(oid) == data
+        # every mon (including the twice-revived peon) converged
+        epochs = {r: m.osdmap.epoch for r, m in c.mons.items()}
+        assert wait_for(
+            lambda: len(
+                {m.store.last_committed() for m in c.mons.values()}
+            )
+            == 1,
+            15.0,
+        ), f"mon stores diverged: {epochs}"
+    finally:
+        stop.set()
+        if client is not None:
+            client.shutdown()
+        for o in osds.values():
+            o.shutdown()
         c.shutdown()

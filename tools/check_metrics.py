@@ -62,6 +62,19 @@ SCRUB_COUNTERS = (
     "scrub_deep_bytes", "scrub_last_age",
 )
 
+# fault-injection counters every messenger schema must declare
+# (msg/faults.py build_msgr_perf → the ceph_msgr_fault_* families)
+FAULT_COUNTERS = (
+    "fault_dropped", "fault_delayed", "fault_duplicated",
+    "fault_socket_failures",
+)
+# fullness gauges the OSD schema must declare (the osd_stat_t carry
+# feeding OSD_NEARFULL/OSD_FULL and the backoff visibility gauge)
+FULLNESS_COUNTERS = (
+    "stat_bytes", "stat_bytes_used", "stat_bytes_avail",
+    "backoffs_active",
+)
+
 CRASH_REQUIRED = (
     "crash_id", "entity_name", "timestamp", "timestamp_iso",
     "exception", "backtrace", "dout_tail", "meta",
@@ -272,6 +285,29 @@ def check_scrub_counters() -> list[str]:
     ]
 
 
+def check_fault_counters() -> list[str]:
+    """The fault-plane families: every messenger's l_msgr_fault_*
+    block and the OSD's fullness gauges — the chaos scenarios and the
+    OSD_NEARFULL/OSD_FULL checks read exactly these."""
+    from ceph_tpu.msg.faults import build_msgr_perf
+    from ceph_tpu.osd.daemon import build_osd_perf
+
+    errors = []
+    msgr_declared = set(build_msgr_perf("lint")._counters)
+    errors.extend(
+        f"msgr schema: fault counter {name!r} missing"
+        for name in FAULT_COUNTERS
+        if name not in msgr_declared
+    )
+    osd_declared = set(build_osd_perf(0)._counters)
+    errors.extend(
+        f"osd schema: fullness gauge {name!r} missing"
+        for name in FULLNESS_COUNTERS
+        if name not in osd_declared
+    )
+    return errors
+
+
 def product_event_samples() -> list[str]:
     """Generate one real clog entry and one real crash report through
     the product code paths and lint them — the schemas daemons
@@ -326,6 +362,7 @@ def check_perf_counters(pc) -> list[str]:
 def product_counter_sets():
     """Every schema the product registers (import side effects force
     lazy groups into existence so the lint sees the real shape)."""
+    from ceph_tpu.msg.faults import build_msgr_perf
     from ceph_tpu.ops.kernel_stats import KernelStats
     from ceph_tpu.osd.daemon import build_osd_perf
     from ceph_tpu.osd.mapping import _build_perf as build_mapping_perf
@@ -336,7 +373,10 @@ def product_counter_sets():
                   "gf_bitmatrix", "crush"):
         ks.record(group)
     ks.counter("crush", "pgs")
-    return [build_osd_perf(0), build_mapping_perf(), ks.perf]
+    return [
+        build_osd_perf(0), build_mapping_perf(), ks.perf,
+        build_msgr_perf("osd.0"),
+    ]
 
 
 def check_all(sets=None) -> list[str]:
@@ -360,6 +400,7 @@ def check_all(sets=None) -> list[str]:
         errors.extend(product_event_samples())
         errors.extend(product_scrub_samples())
         errors.extend(check_scrub_counters())
+        errors.extend(check_fault_counters())
     return errors
 
 
