@@ -8,6 +8,7 @@ same two services for the TPU framework's daemons and tools.
 
 from .admin_socket import AdminSocket, admin_command
 from .config import Config, Option, OPT_INT, OPT_STR, OPT_BOOL, OPT_FLOAT
+from .histogram import LogHistogram, PerfHistogram2D
 from .log_client import LogChannel, LogClient
 from .op_tracker import OpTracker, TrackedOp
 from .perf_counters import (
@@ -23,7 +24,9 @@ __all__ = [
     "Config",
     "LogChannel",
     "LogClient",
+    "LogHistogram",
     "OpTracker",
+    "PerfHistogram2D",
     "Span",
     "TrackedOp",
     "Tracer",

@@ -238,6 +238,17 @@ SCHEMA: dict[str, Option] = {
             level=LEVEL_BASIC,
         ),
         Option(
+            "slo_targets",
+            OPT_STR,
+            "",
+            "latency SLO targets the mgr slo module evaluates: "
+            "whitespace/comma-separated "
+            "<class>_p<pct>_ms=<target>[@<objective>] tokens, e.g. "
+            "'client_p99_ms=50@99.9 bulk_p95_ms=500' (empty = no "
+            "SLO evaluation)",
+            level=LEVEL_BASIC,
+        ),
+        Option(
             "tracing_enabled",
             OPT_BOOL,
             True,

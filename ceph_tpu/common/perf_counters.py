@@ -80,6 +80,10 @@ class PerfCounters:
         c = self._counters[name]
         assert c.kind == PERFCOUNTER_HISTOGRAM
         with self._lock:
+            # sum + count accumulate alongside the buckets so the
+            # exporter can emit the prometheus-native _sum/_count pair
+            c.value += value
+            c.avgcount += 1
             for i, bound in enumerate(c.bucket_bounds):
                 if value <= bound:
                     c.buckets[i] += 1
@@ -106,6 +110,8 @@ class PerfCounters:
                     out[name] = {
                         "bounds": list(c.bucket_bounds),
                         "buckets": list(c.buckets),
+                        "sum": c.value,
+                        "count": c.avgcount,
                     }
                 else:
                     out[name] = c.value

@@ -49,6 +49,41 @@ from ..msg.messenger import Dispatcher
 __all__ = ["Manager", "MgrModule"]
 
 
+def histogram_exposition_lines(
+    name: str, help_: str, series: list
+) -> list[str]:
+    """Render ONE prometheus-native histogram family: a single
+    HELP/TYPE header, then per-labelset cumulative ``_bucket`` rows
+    (monotone, closing with the mandatory ``le="+Inf"``) plus the
+    ``_sum``/``_count`` pair.  ``series`` is [(labels dict, histogram
+    snapshot)].  Module-level so tools/check_metrics.py lints the
+    exact text the exporter serves."""
+    from ..common.histogram import cumulative_buckets, snapshot_counts
+
+    name = PrometheusModule.sanitize_name(name)
+    out = [f"# HELP {name} {help_}", f"# TYPE {name} histogram"]
+
+    def lbl(labels: dict) -> str:
+        return ",".join(
+            f"{PrometheusModule.sanitize_name(k)}="
+            f'"{PrometheusModule.escape_label(v)}"'
+            for k, v in labels.items()
+        )
+
+    for labels, snap in series:
+        base = lbl(labels)
+        for le, cum in cumulative_buckets(snap):
+            sep = "," if base else ""
+            out.append(
+                f'{name}_bucket{{{base}{sep}le="{le}"}} {cum}'
+            )
+        total = sum(snapshot_counts(snap))
+        braces = f"{{{base}}}" if base else ""
+        out.append(f"{name}_sum{braces} {float(snap.get('sum', 0.0))}")
+        out.append(f"{name}_count{braces} {total}")
+    return out
+
+
 class MgrModule:
     """Base class for manager modules (mgr_module.MgrModule)."""
 
@@ -106,6 +141,7 @@ class Manager(Dispatcher):
                 DashboardModule,
                 TracingModule,
                 CrashModule,
+                SLOModule,
             ]
         )
         self.modules: dict[str, MgrModule] = {}
@@ -574,6 +610,79 @@ class PrometheusModule(MgrModule):
                         fam, dump[key], help_,
                         labels={"ceph_daemon": daemon}, kind=kind,
                     )
+        # latency histograms → NATIVE prometheus histogram families
+        # (cumulative le buckets ending +Inf, _sum/_count): the
+        # op_hist.<qos>.<type> entries become one labeled family,
+        # everything else histogram-shaped gets its own
+        from ..common.histogram import is_histogram_snapshot
+
+        hist_families: dict[str, dict] = {}
+        for daemon, dump in sorted(
+            (self.get("daemon_perf") or {}).items()
+        ):
+            for cname, val in sorted(dump.items()):
+                if not is_histogram_snapshot(val):
+                    continue
+                if cname.startswith("op_hist."):
+                    parts = cname.split(".")
+                    fam = "ceph_osd_op_latency_seconds"
+                    help_ = (
+                        "op completion latency by qos class and "
+                        "op type (log2 buckets)"
+                    )
+                    labels = {
+                        "ceph_daemon": daemon,
+                        "qos_class": parts[1] if len(parts) > 1 else "",
+                        "op_type": parts[2] if len(parts) > 2 else "",
+                    }
+                else:
+                    fam = (
+                        "ceph_daemon_"
+                        + cname.replace(".", "_")
+                        + "_seconds"
+                    )
+                    help_ = f"per-daemon latency histogram {cname}"
+                    labels = {"ceph_daemon": daemon}
+                hist_families.setdefault(
+                    fam, {"help": help_, "series": []}
+                )["series"].append((labels, val))
+        for fam, ent in sorted(hist_families.items()):
+            if fam in headered:
+                continue
+            headered.add(fam)
+            out.extend(
+                histogram_exposition_lines(
+                    fam, ent["help"], ent["series"]
+                )
+            )
+        # SLO plane rollups: burn rates + windowed percentiles per
+        # class from the slo module's last evaluation
+        slo_mod = self.mgr.modules.get("slo")
+        status = getattr(slo_mod, "last_status", None) or {}
+        for tgt in status.get("targets", []):
+            for window in ("fast", "slow"):
+                metric(
+                    "ceph_slo_burn_rate",
+                    tgt.get(f"{window}_burn", 0.0),
+                    "error-budget burn rate per slo target and window",
+                    labels={
+                        "qos_class": tgt.get("qos_class", ""),
+                        "percentile": f"{tgt.get('percentile', 0):g}",
+                        "window": window,
+                    },
+                )
+        for klass, row in sorted(
+            (status.get("classes") or {}).items()
+        ):
+            for q in (50, 95, 99):
+                metric(
+                    "ceph_slo_latency_ms",
+                    row.get(f"p{q}_ms", 0.0),
+                    "windowed latency percentile per qos class",
+                    labels={
+                        "qos_class": klass, "quantile": f"0.{q}"
+                    },
+                )
         for entry in self.get("df")["pools"]:
             metric(
                 "ceph_pool_pg_num",
@@ -927,12 +1036,25 @@ class TracingModule(MgrModule):
             "roots": tracing.assemble_tree(spans),
         }
 
-    def dump(self) -> dict:
-        """Summary of every held trace (the dump_traces rollup)."""
+    def dump(self, qos_class: str = "") -> dict:
+        """Summary of every held trace (the dump_traces rollup).
+        ``qos_class`` keeps only traces whose spans carry that class
+        tag (the objecter stamps it on every root span, the primary
+        on every osd_op span — PR 1 left class invisible here)."""
         with self._lock:
+            entries = {
+                tid: e
+                for tid, e in self._traces.items()
+                if not qos_class
+                or any(
+                    s.get("tags", {}).get("qos_class") == qos_class
+                    for s in e["spans"]
+                )
+            }
             return {
-                "num_traces": len(self._traces),
+                "num_traces": len(entries),
                 "spans_ingested": self.spans_ingested,
+                "qos_class": qos_class,
                 "traces": {
                     tid: {
                         "num_spans": len(e["spans"]),
@@ -943,9 +1065,56 @@ class TracingModule(MgrModule):
                             }
                         ),
                     }
-                    for tid, e in self._traces.items()
+                    for tid, e in entries.items()
                 },
             }
+
+    def handle_command(self, cmd: dict) -> MMonCommandReply:
+        """`ceph tracing dump [qos_class=X]` / `ceph tracing
+        summary` — the per-class filter/aggregation surface (routed
+        to the active mgr like crash/slo commands)."""
+        self.ingest_pending()  # fresh spans show up now
+        prefix = cmd.get("prefix", "")
+        if prefix == "tracing dump":
+            return MMonCommandReply(
+                outb=json.dumps(
+                    self.dump(str(cmd.get("qos_class", "")))
+                )
+            )
+        if prefix == "tracing summary":
+            return MMonCommandReply(
+                outb=json.dumps(self.class_summary())
+            )
+        return MMonCommandReply(
+            rc=-22, outs=f"unknown tracing command {prefix!r}"
+        )
+
+    def class_summary(self) -> dict:
+        """Span counts + mean duration per qos_class across every
+        held trace — the per-class aggregation seat."""
+        agg: dict[str, dict] = {}
+        with self._lock:
+            spans = [
+                s
+                for e in self._traces.values()
+                for s in e["spans"]
+            ]
+        for s in spans:
+            klass = str(
+                (s.get("tags") or {}).get("qos_class") or "untagged"
+            )
+            row = agg.setdefault(
+                klass, {"spans": 0, "total_duration": 0.0}
+            )
+            row["spans"] += 1
+            row["total_duration"] += float(s.get("duration", 0.0))
+        for row in agg.values():
+            row["mean_duration"] = (
+                row["total_duration"] / row["spans"]
+                if row["spans"]
+                else 0.0
+            )
+        return agg
 
 
 class CrashModule(MgrModule):
@@ -1213,3 +1382,10 @@ class PgAutoscalerModule(MgrModule):
                         self.applied += 1
             else:
                 self.recommendations.pop(name, None)
+
+
+# imported last: slo.py subclasses MgrModule from this module (the
+# bottom import breaks the would-be cycle)
+from .slo import SLOModule  # noqa: E402
+
+__all__.append("SLOModule")

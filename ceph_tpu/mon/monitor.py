@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import re as _re
+import sys
 import threading
 import time
 from collections import deque
@@ -322,6 +323,15 @@ class Monitor(Dispatcher):
         # osd_stat_t role): osd -> (wallclock received, kb, kb_used,
         # kb_avail).  Feeds OSD_NEARFULL / OSD_FULL
         self.osd_stats: dict[int, tuple[float, int, int, int]] = {}
+        # per-OSD commit/apply latency (the osd_stat_t perf seat
+        # `ceph osd perf` serves): osd -> (ts, commit_ms, apply_ms)
+        self.osd_perf_stats: dict[int, tuple[float, float, float]] = {}
+        # SLO burn-rate verdicts pushed by the mgr slo module ("slo
+        # report", the RECENT_CRASH push idiom): code -> (wallclock
+        # received, severity, summary).  An empty push clears; stale
+        # reports age out with the slow-op grace (a dead mgr must not
+        # pin SLO_LATENCY forever)
+        self.slo_reports: dict[str, tuple[float, str, str]] = {}
         # last health-check code set, so transitions (raise/clear)
         # write the cluster log — the health timeline
         self._prev_health: set[str] = set()
@@ -545,6 +555,18 @@ class Monitor(Dispatcher):
                     "crashed"
                 ),
             }
+        # SLO_LATENCY (the mgr slo module's burn-rate verdicts): the
+        # mgr re-pushes every tick while burning, so stale entries age
+        # out on the slow-op grace — an evaluator that died mid-burn
+        # cannot pin the check
+        grace = self.slow_op_report_grace()
+        for code, (ts, severity, summary) in list(
+            self.slo_reports.items()
+        ):
+            if now - ts > grace:
+                del self.slo_reports[code]
+                continue
+            checks[code] = {"severity": severity, "summary": summary}
         cur = set(checks)
         for code in sorted(cur - self._prev_health):
             self._clog(
@@ -648,7 +670,7 @@ class Monitor(Dispatcher):
             # periodic daemon chatter
             "mds beacon", "mgr beacon", "osd slow ops",
             "crash report", "osd scrub errors", "osd stat report",
-            "osd df",
+            "osd df", "osd perf", "slo report",
         }
     )
 
@@ -1175,6 +1197,17 @@ def _cmd_osd_stat_report(mon: Monitor, cmd: dict) -> MMonCommandReply:
     kb_used = max(0, int(cmd.get("kb_used", 0)))
     kb_avail = max(0, int(cmd.get("kb_avail", 0)))
     mon.osd_stats[osd] = (time.time(), kb, kb_used, kb_avail)
+    # optional perf seat (commit/apply latency → `ceph osd perf`);
+    # apply defaults to commit — the stores have no journal split
+    if "commit_latency_ms" in cmd:
+        try:
+            commit = max(0.0, float(cmd["commit_latency_ms"]))
+            apply_ = max(
+                0.0, float(cmd.get("apply_latency_ms", commit))
+            )
+            mon.osd_perf_stats[osd] = (time.time(), commit, apply_)
+        except (TypeError, ValueError):
+            pass  # malformed perf seat: keep the space stats
     # the reply carries the EFFECTIVE ratios so the OSD's write gate
     # follows `ceph config set mon mon_osd_full_ratio ...` instead of
     # diverging from the health check on its local schema default
@@ -1221,6 +1254,78 @@ def _cmd_osd_df(mon: Monitor, cmd: dict) -> MMonCommandReply:
             }
         )
     )
+
+
+def _cmd_osd_perf(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """'ceph osd perf' (OSDMonitor's osd_stat_t perf view): per-OSD
+    commit/apply latency from the freshest stat reports — the CLI
+    table the reference prints from PGMap::dump_osd_perf_stats."""
+    now = time.time()
+    infos = []
+    for osd, (ts, commit, apply_) in sorted(
+        mon.osd_perf_stats.items()
+    ):
+        if not mon.osdmap.is_up(osd) or now - ts > STAT_REPORT_GRACE:
+            del mon.osd_perf_stats[osd]
+            continue
+        infos.append(
+            {
+                "id": osd,
+                "perf_stats": {
+                    "commit_latency_ms": commit,
+                    "apply_latency_ms": apply_,
+                },
+            }
+        )
+    return MMonCommandReply(
+        outs="\n".join(
+            ["osd  commit_latency(ms)  apply_latency(ms)"]
+            + [
+                f"{e['id']:>3}  "
+                f"{e['perf_stats']['commit_latency_ms']:>18.3f}  "
+                f"{e['perf_stats']['apply_latency_ms']:>17.3f}"
+                for e in infos
+            ]
+        ),
+        outb=json.dumps({"osd_perf_infos": infos}),
+    )
+
+
+_SLO_SEVERITIES = ("HEALTH_WARN", "HEALTH_ERR")
+MAX_SLO_CHECKS = 32
+MAX_SLO_SUMMARY = 512
+
+
+def _cmd_slo_report(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """mgr slo module → mon: the current burn-rate verdicts (the
+    mgr-raised health-check push, same idiom as "crash report").
+    Each push REPLACES the set — an empty ``checks`` clears
+    SLO_LATENCY immediately; entries are bounded and validated
+    because they render into health summaries and the cluster log."""
+    checks = cmd.get("checks", {})
+    if not isinstance(checks, dict):
+        return MMonCommandReply(rc=-22, outs="checks must be a dict")
+    if len(checks) > MAX_SLO_CHECKS:
+        return MMonCommandReply(
+            rc=-7, outs="too many slo checks (-E2BIG)"
+        )
+    now = time.time()
+    accepted: dict[str, tuple[float, str, str]] = {}
+    for code, det in checks.items():
+        code = str(code)
+        if not code.startswith("SLO_") or len(code) > MAX_MUTE_CODE_LEN:
+            return MMonCommandReply(
+                rc=-22, outs=f"bad slo check code {code!r}"
+            )
+        severity = str(det.get("severity", "HEALTH_WARN"))
+        if severity not in _SLO_SEVERITIES:
+            return MMonCommandReply(
+                rc=-22, outs=f"bad severity {severity!r}"
+            )
+        summary = str(det.get("summary", ""))[:MAX_SLO_SUMMARY]
+        accepted[code] = (now, severity, summary)
+    mon.slo_reports = accepted
+    return MMonCommandReply(outb=json.dumps({"ok": True}))
 
 
 def _cmd_tell(mon: Monitor, cmd: dict) -> MMonCommandReply:
@@ -1976,6 +2081,8 @@ _COMMANDS = {
     "osd scrub errors": _cmd_osd_scrub_errors,
     "osd stat report": _cmd_osd_stat_report,
     "osd df": _cmd_osd_df,
+    "osd perf": _cmd_osd_perf,
+    "slo report": _cmd_slo_report,
     "tell": _cmd_tell,
     "pg scrub": _cmd_pg_scrub,
     "pg deep-scrub": _cmd_pg_scrub,
@@ -2060,6 +2167,12 @@ class MonClient(Dispatcher):
         client that only watches the map would otherwise go stale
         until its next command (MonClient::_reopen_session)."""
         if conn is not self._conn or not self._addrs:
+            return
+        if sys.is_finalizing():
+            # interpreter teardown: connection resets fire as the GC
+            # finalizes the messenger loop, and Thread.start() HANGS
+            # during finalization (the new thread never bootstraps) —
+            # a short-lived CLI would wedge on exit instead of exiting
             return
         threading.Thread(
             target=self._reconnect_bg,

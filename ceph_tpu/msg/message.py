@@ -410,13 +410,17 @@ class MOSDOp(Message):
     # op flags (OSD_FLAG_*): FULL_TRY lets repair/delete traffic land
     # on a full OSD instead of parking on backoff
     flags: int = 0
+    # QoS class (the dmclock client-class tag): the primary enqueues
+    # this op under the named scheduler class when its profile is
+    # registered, else under the default client class; empty = client
+    qos: str = ""
 
     def encode_payload(self, e: Encoder) -> None:
         e.s64(self.pool).string(self.pgid).string(self.oid)
         e.u8(self.op).u64(self.offset).s64(self.length)
         e.bytes(self.data).string(self.attr).string(self.reqid)
         e.u32(self.epoch).u64(self.snapid).u64(self.snap_seq)
-        e.u32(self.flags)
+        e.u32(self.flags).string(self.qos)
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MOSDOp":
@@ -426,8 +430,9 @@ class MOSDOp(Message):
             data=d.bytes(), attr=d.string(), reqid=d.string(),
             epoch=d.u32(), snapid=d.u64(), snap_seq=d.u64(),
             # versioned-decode tolerance: frames from before the
-            # backoff plane carry no flags word
+            # backoff plane carry no flags word, pre-SLO ones no qos
             flags=d.u32() if d.remaining() else 0,
+            qos=d.string() if d.remaining() else "",
         )
 
 

@@ -36,12 +36,19 @@ from __future__ import annotations
 import threading
 import time
 
+from ..common.histogram import LATENCY_BUCKETS, LATENCY_MIN_S, log2_bounds
 from ..common.perf_counters import (
+    PERFCOUNTER_HISTOGRAM,
     PERFCOUNTER_TIME,
     PERFCOUNTER_U64,
     PerfCounters,
     _Counter,
 )
+
+# the shared log2 latency axis (common/histogram.py): every
+# l_tpu_*_lat_hist uses it, so kernel latency histograms merge with
+# the op-path ones under one bucket layout
+_LAT_HIST_BOUNDS = log2_bounds(LATENCY_MIN_S, LATENCY_BUCKETS)
 
 
 class KernelStats:
@@ -55,10 +62,15 @@ class KernelStats:
         self._ensure_counter("l_tpu_compile_cache_miss", PERFCOUNTER_U64,
                              "device bitmatrix/table cache misses")
 
-    def _ensure_counter(self, name: str, kind: str, desc: str) -> None:
+    def _ensure_counter(
+        self, name: str, kind: str, desc: str, bounds: tuple = ()
+    ) -> None:
         with self.perf._lock:
             if name not in self.perf._counters:
-                self.perf._counters[name] = _Counter(name, kind, desc)
+                c = _Counter(name, kind, desc, bucket_bounds=bounds)
+                if kind == PERFCOUNTER_HISTOGRAM:
+                    c.buckets = [0] * (len(bounds) + 1)
+                self.perf._counters[name] = c
 
     def _ensure_group(self, group: str) -> None:
         with self._lock:
@@ -76,6 +88,14 @@ class KernelStats:
             )
             self._ensure_counter(
                 f"{base}_lat", PERFCOUNTER_TIME, f"{group} kernel latency"
+            )
+            # histogram variant of the sync-bounded latency: the avg
+            # pair answers "mean", the log2 buckets answer "p99"
+            self._ensure_counter(
+                f"{base}_lat_hist",
+                PERFCOUNTER_HISTOGRAM,
+                f"{group} kernel latency distribution (log2 buckets)",
+                bounds=_LAT_HIST_BOUNDS,
             )
             self._groups.add(group)
 
@@ -95,6 +115,7 @@ class KernelStats:
         if bytes_out:
             self.perf.inc(f"{base}_bytes_out", int(bytes_out))
         self.perf.tinc(f"{base}_lat", seconds)
+        self.perf.hinc(f"{base}_lat_hist", seconds)
 
     def record_cache(self, hits: int, misses: int) -> None:
         if hits:

@@ -88,12 +88,15 @@ from ..msg import (
 from dataclasses import dataclass
 
 from ..common import tracing
+from ..common.histogram import LogHistogram, PerfHistogram2D
+from ..common.op_tracker import sanitize_class
 from ..common.perf_counters import PerfCountersBuilder
 from ..common.throttle import Throttle
 from .scheduler import (
     CLASS_BACKGROUND,
     CLASS_CLIENT,
     CLASS_RECOVERY,
+    CLASS_STRICT,
     MClockQueue,
     WeightedPriorityQueue,
 )
@@ -309,6 +312,7 @@ class OSD(Dispatcher):
         admin_socket_path: str | None = None,
         client_message_cap: int = 256 << 20,
         op_queue: str = "wpq",
+        qos_profiles: dict | None = None,
     ):
         """``scrub_interval`` > 0 arms tick-driven scrub scheduling
         (osd_scrub_min_interval); ``deep_scrub_interval`` spaces the
@@ -335,8 +339,19 @@ class OSD(Dispatcher):
         # (osd_op_queue: wpq | mclock_scheduler)
         if op_queue in ("mclock", "mclock_scheduler"):
             self._workq = MClockQueue()
+            # per-tenant QoS classes (the mclock client profiles):
+            # {class: (reservation, weight, limit)} in cost-units/sec
+            # — client ops naming a registered class schedule under
+            # its triple; unknown classes fall back to CLASS_CLIENT
+            for klass, triple in (qos_profiles or {}).items():
+                self._workq.set_profile(klass, triple)
         elif op_queue == "wpq":
             self._workq = WeightedPriorityQueue()
+            for klass, triple in (qos_profiles or {}).items():
+                # wpq has no reservations: the profile's weight seat
+                # (middle of the triple, or a bare number) applies
+                w = triple[1] if isinstance(triple, (tuple, list)) else triple
+                self._workq.set_weight(klass, int(w))
         else:
             raise ValueError(
                 f"unknown op_queue {op_queue!r} (wpq | mclock)"
@@ -385,7 +400,18 @@ class OSD(Dispatcher):
             self.admin = AdminSocket(
                 str(admin_socket_path), config=self.config
             )
-            self.op_tracker.register_admin_commands(self.admin)
+            # the OSD's own grids merge into the admin-socket `perf
+            # histogram dump` (deferred: the commit grid is built a
+            # few lines below; the hook only runs at command time)
+            self.op_tracker.register_admin_commands(
+                self.admin,
+                extra_histograms=lambda: {
+                    "osd": self.whoami,
+                    "commit_latency_histogram": (
+                        self._commit_grid.dump()
+                    ),
+                },
+            )
             self.tracer.register_admin_commands(self.admin)
             # fault plane: `ceph daemon osd.N fault set/clear/list`
             self.messenger.faults.register_admin_commands(self.admin)
@@ -419,6 +445,17 @@ class OSD(Dispatcher):
         # daemon perf counters (l_osd_* role): pushed to the mgr as
         # MMgrReport on the tick (the DaemonServer stats plane)
         self.perf = build_osd_perf(whoami)
+        # ObjectStore commit latency: the reference-shaped 2D
+        # latency×size grid (src/common/perf_histogram.h, served by
+        # `ceph tell osd.N perf histogram dump`) plus a 1D histogram
+        # whose windowed mean feeds `ceph osd perf` commit_latency_ms
+        self._commit_grid = PerfHistogram2D(
+            name="op_w_latency_in_bytes_histogram"
+        )
+        self._commit_hist = LogHistogram()
+        # (sum, count) at the last stat report — the delta gives the
+        # mean commit latency over the report interval
+        self._commit_last = (0.0, 0)
         if self.admin is not None:
             # `perf dump` over the admin socket serves the daemon's
             # counters AND the process-global device-kernel plane
@@ -1163,22 +1200,59 @@ class OSD(Dispatcher):
             self.store.queue_transaction(txn)
 
     # -- client op path (primary) ------------------------------------------
+    # scheduler classes a CLIENT may never name: strict would bypass
+    # QoS outright, and recovery/background would let a tenant ride
+    # the recovery reservation while starving real recovery traffic
+    _QOS_INTERNAL = frozenset(
+        {CLASS_STRICT, CLASS_RECOVERY, CLASS_BACKGROUND}
+    )
+
+    def _qos_class_of(self, msg: MOSDOp) -> str:
+        """The scheduler class this op rides: its named QoS class
+        when a profile is registered AND the name is not an internal
+        scheduler class, else the default client class (an unknown or
+        reserved class must degrade, not bypass, QoS)."""
+        qos = sanitize_class(msg.qos, default=CLASS_CLIENT)
+        if qos in self._QOS_INTERNAL:
+            return CLASS_CLIENT
+        if qos != CLASS_CLIENT and not self._workq.known_class(qos):
+            return CLASS_CLIENT
+        return qos
+
+    @staticmethod
+    def _op_type_of(op: int) -> str:
+        if op in (
+            OSD_OP_READ, OSD_OP_STAT, OSD_OP_GETXATTR, OSD_OP_OMAPGET,
+        ):
+            return "read"
+        if op == OSD_OP_LIST:
+            return "list"
+        return "write"
+
     def _handle_op(self, conn: Connection, msg: MOSDOp) -> None:
         t0 = time.perf_counter()
+        qos_class = self._qos_class_of(msg)
+        op_type = self._op_type_of(msg.op)
         top = self.op_tracker.create_op(
             f"osd_op({msg.reqid} {msg.pgid} {msg.oid} op={msg.op})",
             trace=msg.reqid,
+            op_type=op_type,
+            qos_class=qos_class,
         )
         top.mark_event("started")
         self._cur_op = top
         # primary-side span under the client's trace (= reqid): the
         # `with` installs it as this worker thread's ambient, so the
-        # store layers' per-stage spans attach as children
+        # store layers' per-stage spans attach as children; qos_class
+        # rides the tags so the mgr tracing module filters per class
         span = self.tracer.start_span(
             "osd_op",
             trace_id=msg.reqid or "",
             role=tracing.ROLE_PRIMARY,
-            tags={"pgid": msg.pgid, "oid": msg.oid, "op": msg.op},
+            tags={
+                "pgid": msg.pgid, "oid": msg.oid, "op": msg.op,
+                "qos_class": qos_class,
+            },
         )
         try:
             with span:
@@ -1895,12 +1969,24 @@ class OSD(Dispatcher):
         for txn in {id(t): t for t in txn_by_osd.values()}.values():
             self._persist_entry(pg, entry, txn)
             self._persist_info(pg, txn)
+        commit_t0 = time.perf_counter()
         try:
             self.store.queue_transaction(txn_by_osd[self.whoami])
         except StoreError:
             pg.info.last_update = saved_last
             pg.seq -= 1
             raise
+        # commit latency × request size into the per-OSD grid (the
+        # PerfHistogram seat `ceph tell osd.N perf histogram dump`
+        # serves) and the 1D histogram `ceph osd perf` windows
+        commit_lat = time.perf_counter() - commit_t0
+        txn_bytes = sum(
+            len(op[4])
+            for op in txn_by_osd[self.whoami].ops
+            if op[0] == "write"
+        )
+        self._commit_grid.add(commit_lat, float(max(txn_bytes, 1)))
+        self._commit_hist.add(commit_lat)
         pg.log.append(entry)
         if msg.reqid:
             pg.reqid_cache[msg.reqid] = (version, outdata)
@@ -2400,7 +2486,7 @@ class OSD(Dispatcher):
                     pass
                 return True
             self._workq.enqueue(
-                CLASS_CLIENT, cost, ("op", conn, msg, cost)
+                self._qos_class_of(msg), cost, ("op", conn, msg, cost)
             )
             return True
         if isinstance(msg, MOSDRepOp):
@@ -2674,6 +2760,16 @@ class OSD(Dispatcher):
             daemon=True,
         ).start()
 
+    def _commit_latency_ms(self) -> float:
+        """Mean commit latency since the last stat report (the
+        osd_stat_t commit_latency_ms seat `ceph osd perf` serves)."""
+        snap = self._commit_hist.snapshot()
+        psum, pcount = self._commit_last
+        dsum = snap["sum"] - psum
+        dcount = snap["count"] - pcount
+        self._commit_last = (snap["sum"], snap["count"])
+        return round(1000.0 * dsum / dcount, 3) if dcount > 0 else 0.0
+
     def _send_stat_report(self, stats: dict) -> None:
         try:
             reply = self.monc.command(
@@ -2683,6 +2779,9 @@ class OSD(Dispatcher):
                     "kb": stats["total"] // 1024,
                     "kb_used": stats["used"] // 1024,
                     "kb_avail": stats["avail"] // 1024,
+                    # our store has no journal/apply split: apply
+                    # mirrors commit (documented deviation)
+                    "commit_latency_ms": self._commit_latency_ms(),
                 },
                 timeout=2.0,
             )
@@ -2719,6 +2818,23 @@ class OSD(Dispatcher):
                 dump = dict(self.perf.dump())
                 dump.update(self.messenger.faults.perf.dump())
                 reply.outb = json.dumps(dump)
+            elif prefix == "perf histogram dump":
+                # the `ceph daemonperf`/`perf histogram dump` tell
+                # surface: raw grids, not rollups — per-(qos, type)
+                # completion + per-stage gaps + the commit grid
+                out = self.op_tracker.dump_histograms()
+                out["osd"] = self.whoami
+                out["commit_latency_histogram"] = (
+                    self._commit_grid.dump()
+                )
+                reply.outb = json.dumps(out)
+            elif prefix == "dump_historic_slow_ops":
+                reply.outb = json.dumps(
+                    self.op_tracker.dump_historic_slow_ops(
+                        float(cmd.get("threshold", 0.0)),
+                        str(cmd.get("qos_class", "")),
+                    )
+                )
             else:
                 reply.rc = -22
                 reply.outs = f"unknown tell command {prefix!r}"
@@ -3007,6 +3123,12 @@ class OSD(Dispatcher):
             # fault-plane counters (l_msgr_fault_*) ride the same
             # perf → MMgrReport → prometheus pipe
             dump.update(self.messenger.faults.perf.dump())
+            # latency histograms (op_hist.<qos>.<type> + the commit
+            # distribution): the mgr slo module merges these
+            # cluster-wide; the exporter renders native histogram
+            # families from the same entries
+            dump.update(self.op_tracker.histogram_perf_entries())
+            dump["commit_lat_hist"] = self._commit_hist.snapshot()
             spans = (
                 self.tracer.drain()
                 if self.config.get("tracing_enabled")

@@ -61,12 +61,28 @@ class _SchedulerBase:
 
     def enqueue(self, klass: str, cost: int, item) -> None:
         with self._cond:
-            if klass == CLASS_STRICT or klass not in self._queues:
+            if klass == CLASS_STRICT:
                 self._strict.append(item)
+            elif klass not in self._queues:
+                # an unregistered QoS class must not ride the strict
+                # lane (that would let any client BYPASS QoS by naming
+                # a class): it degrades to the default client class,
+                # or strict only when no client queue exists at all
+                if CLASS_CLIENT in self._queues:
+                    self._enqueue_weighted(
+                        CLASS_CLIENT, max(int(cost), 1), item
+                    )
+                else:
+                    self._strict.append(item)
             else:
                 self._enqueue_weighted(klass, max(int(cost), 1), item)
             self._size += 1
             self._cond.notify()
+
+    def known_class(self, klass: str) -> bool:
+        """True when this scheduler has a registered queue (weight or
+        dmclock profile) for ``klass``."""
+        return klass in self._queues
 
     def qlen(self) -> int:
         with self._lock:
@@ -98,6 +114,16 @@ class WeightedPriorityQueue(_SchedulerBase):
         self._rr = list(self.weights)  # round-robin order
         self._rr_pos = 0
         self._fresh = True  # current class not yet granted this visit
+
+    def set_weight(self, klass: str, weight: int) -> None:
+        """Register (or retune) a weighted class at runtime — the
+        osd_op_queue per-class weight knob."""
+        with self._cond:
+            self.weights[klass] = int(weight)
+            if klass not in self._queues:
+                self._queues[klass] = collections.deque()
+                self._credit[klass] = 0.0
+                self._rr.append(klass)
 
     def _enqueue_weighted(self, klass: str, cost: int, item) -> None:
         self._queues[klass].append((cost, item))
@@ -209,6 +235,18 @@ class MClockQueue(_SchedulerBase):
         self._rtag: dict[str, float] = {}
         self._wtag: dict[str, float] = {}
         self._ltag: dict[str, float] = {}
+
+    def set_profile(
+        self, klass: str, profile: tuple[float, float, float]
+    ) -> None:
+        """Register (or retune) a dmclock class at runtime: the
+        (reservation, weight, limit) triple in cost-units/sec — how
+        per-tenant QoS classes (gold/bulk/...) come to exist."""
+        res, wgt, lim = (float(x) for x in profile)
+        with self._cond:
+            self.profiles[klass] = (res, wgt, lim)
+            if klass not in self._queues:
+                self._queues[klass] = collections.deque()
 
     def _enqueue_weighted(self, klass: str, cost: int, item) -> None:
         now = self.clock()

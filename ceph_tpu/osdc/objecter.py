@@ -262,8 +262,11 @@ class Objecter(Dispatcher):
         snapid: int = 0,
         snap_seq: int = 0,
         flags: int = 0,
+        qos: str = "",
     ) -> MOSDOpReply:
-        """Target, send, and retry until acked or timed out."""
+        """Target, send, and retry until acked or timed out.
+        ``qos`` names the dmclock class the primary schedules this op
+        under (empty = the default client class)."""
         from ..msg.message import (
             OSD_OP_GETXATTR,
             OSD_OP_LIST,
@@ -283,19 +286,25 @@ class Objecter(Dispatcher):
             "client_op",
             trace_id=reqid,
             role=tracing.ROLE_CLIENT,
-            tags={"pool": pool_id, "oid": oid, "op": op},
+            # qos_class rides every span from the objecter down, so
+            # the mgr tracing module and dump_historic_slow_ops can
+            # filter/aggregate per class
+            tags={
+                "pool": pool_id, "oid": oid, "op": op,
+                "qos_class": qos or "client",
+            },
         )
         with root:
             return self._op_submit_attempts(
                 root, deadline, last_err, reqid, pool_id, oid,
                 op, offset, length, data, attr, pgid, snapid,
-                snap_seq, is_read, flags,
+                snap_seq, is_read, flags, qos,
             )
 
     def _op_submit_attempts(
         self, root, deadline, last_err, reqid, pool_id, oid, op,
         offset, length, data, attr, pgid, snapid, snap_seq, is_read,
-        flags,
+        flags, qos,
     ) -> MOSDOpReply:
         from ..msg.message import OSD_OP_LIST
 
@@ -326,6 +335,7 @@ class Objecter(Dispatcher):
                         offset=offset, length=length, data=data,
                         attr=attr, reqid=reqid, epoch=self.monc.epoch,
                         snapid=snapid, snap_seq=snap_seq, flags=flags,
+                        qos=qos,
                     ),
                     timeout=min(5.0, self.op_timeout),
                 )

@@ -288,9 +288,22 @@ class IoCtx:
         # space still lands on a full OSD instead of parking on
         # backoff
         self.full_try = False
+        # dmclock QoS class every op from this handle carries (the
+        # mclock client-class tag; empty = the default client class)
+        self.qos_class = ""
 
     def set_pool_full_try(self, enabled: bool = True) -> None:
         self.full_try = bool(enabled)
+
+    def set_qos_class(self, qos: str) -> None:
+        """Tag every subsequent op from this handle with a scheduler
+        QoS class; primaries with a registered profile for it apply
+        that (reservation, weight, limit) triple."""
+        self.qos_class = str(qos)
+
+    def _submit(self, *args, **kwargs):
+        kwargs.setdefault("qos", self.qos_class)
+        return self.rados.objecter.op_submit(*args, **kwargs)
 
     def _mut_flags(self, full_try: bool = False) -> int:
         return (
@@ -301,13 +314,13 @@ class IoCtx:
 
     # -- sync data ops -----------------------------------------------------
     def write_full(self, oid: str, data: bytes) -> None:
-        self.rados.objecter.op_submit(
+        self._submit(
             self.pool_id, oid, OSD_OP_WRITEFULL, data=bytes(data),
             snap_seq=self.write_snap_seq, flags=self._mut_flags(),
         )
 
     def write(self, oid: str, data: bytes, offset: int = 0) -> None:
-        self.rados.objecter.op_submit(
+        self._submit(
             self.pool_id, oid, OSD_OP_WRITE, offset=offset,
             data=bytes(data), snap_seq=self.write_snap_seq,
             flags=self._mut_flags(),
@@ -317,7 +330,7 @@ class IoCtx:
         """Atomic append: the offset resolves on the primary inside
         the PG op stream (a client-side stat+write would race
         concurrent appenders)."""
-        self.rados.objecter.op_submit(
+        self._submit(
             self.pool_id, oid, OSD_OP_APPEND, data=bytes(data),
             snap_seq=self.write_snap_seq, flags=self._mut_flags(),
         )
@@ -331,7 +344,7 @@ class IoCtx:
     ) -> bytes:
         """``snapid`` overrides the ioctx read context for ONE call
         (rbd clone parent reads pin their parent snap this way)."""
-        reply = self.rados.objecter.op_submit(
+        reply = self._submit(
             self.pool_id, oid, OSD_OP_READ, offset=offset,
             length=length,
             snapid=self.read_snap if snapid is None else snapid,
@@ -342,13 +355,13 @@ class IoCtx:
         """``full_try`` lets THIS delete land on a full OSD
         (OSD_FLAG_FULL_TRY) without flipping the whole handle —
         the space-reclaim path out of OSD_FULL."""
-        self.rados.objecter.op_submit(
+        self._submit(
             self.pool_id, oid, OSD_OP_DELETE,
             flags=self._mut_flags(full_try),
         )
 
     def stat(self, oid: str) -> int:
-        reply = self.rados.objecter.op_submit(
+        reply = self._submit(
             self.pool_id, oid, OSD_OP_STAT, snapid=self.read_snap
         )
         return reply.size
@@ -454,7 +467,7 @@ class IoCtx:
             if cookie not in self.rados._watch_cbs:
                 break
         self.rados._watch_cbs[cookie] = callback
-        self.rados.objecter.op_submit(
+        self._submit(
             self.pool_id, oid, OSD_OP_WATCH, offset=cookie
         )
         self.rados.objecter.linger_register(
@@ -465,26 +478,26 @@ class IoCtx:
     def unwatch(self, oid: str, cookie: int) -> None:
         self.rados.objecter.linger_unregister(cookie)
         self.rados._watch_cbs.pop(cookie, None)
-        self.rados.objecter.op_submit(
+        self._submit(
             self.pool_id, oid, OSD_OP_UNWATCH, offset=cookie
         )
 
     def notify(self, oid: str, payload: bytes = b"") -> list[dict]:
         """Notify every watcher; returns their ack records."""
-        reply = self.rados.objecter.op_submit(
+        reply = self._submit(
             self.pool_id, oid, OSD_OP_NOTIFY, data=bytes(payload)
         )
         return json.loads(reply.data) if reply.data else []
 
     # -- xattrs ------------------------------------------------------------
     def set_xattr(self, oid: str, name: str, value: bytes) -> None:
-        self.rados.objecter.op_submit(
+        self._submit(
             self.pool_id, oid, OSD_OP_SETXATTR, attr=name,
             data=bytes(value), flags=self._mut_flags(),
         )
 
     def get_xattr(self, oid: str, name: str) -> bytes:
-        reply = self.rados.objecter.op_submit(
+        reply = self._submit(
             self.pool_id, oid, OSD_OP_GETXATTR, attr=name,
             snapid=self.read_snap,
         )
@@ -498,7 +511,7 @@ class IoCtx:
             lambda e2, k: e2.string(k),
             lambda e2, v: e2.bytes(bytes(v)),
         )
-        self.rados.objecter.op_submit(
+        self._submit(
             self.pool_id, oid, OSD_OP_OMAPSET, data=e.getvalue(),
             flags=self._mut_flags(),
         )
@@ -510,7 +523,7 @@ class IoCtx:
         max_return: int = -1,
         snapid: int | None = None,
     ) -> dict[str, bytes]:
-        reply = self.rados.objecter.op_submit(
+        reply = self._submit(
             self.pool_id, oid, OSD_OP_OMAPGET,
             attr=start_after, length=max_return,
             snapid=self.read_snap if snapid is None else snapid,
@@ -522,13 +535,13 @@ class IoCtx:
     def omap_rm_keys(self, oid: str, keys) -> None:
         e = Encoder()
         e.list(list(keys), lambda e2, k: e2.string(k))
-        self.rados.objecter.op_submit(
+        self._submit(
             self.pool_id, oid, OSD_OP_OMAPRM, data=e.getvalue(),
             flags=self._mut_flags(),
         )
 
     def omap_clear(self, oid: str) -> None:
-        self.rados.objecter.op_submit(
+        self._submit(
             self.pool_id, oid, OSD_OP_OMAPCLEAR,
             flags=self._mut_flags(),
         )
@@ -540,7 +553,7 @@ class IoCtx:
         ClassHandler dispatch).  Carries the handle's FULL_TRY flag:
         the OSD classifies CLS_WR methods as writes, so a reclaim
         class call must not park on a full OSD."""
-        reply = self.rados.objecter.op_submit(
+        reply = self._submit(
             self.pool_id, oid, OSD_OP_CALL,
             attr=f"{cls}.{method}", data=bytes(indata),
             flags=self._mut_flags(),
@@ -553,7 +566,7 @@ class IoCtx:
         names: set[str] = set()
         for ps in range(pool.pg_num):
             pgid = f"{self.pool_id}.{ps}"
-            reply = self.rados.objecter.op_submit(
+            reply = self._submit(
                 self.pool_id, "", OSD_OP_LIST, pgid=pgid
             )
             names.update(reply.names)

@@ -67,7 +67,16 @@ def _build_tell_args(args: list[str]) -> dict:
             else:
                 cmd[k] = _coerce(v)
         return cmd
-    return {"prefix": " ".join(args)}
+    # generic daemon commands (`perf histogram dump`,
+    # `dump_historic_slow_ops threshold=1 qos_class=gold`, ...):
+    # bare words join into the prefix, k=v tokens become arguments
+    words = [a for a in args if "=" not in a]
+    cmd = {"prefix": " ".join(words)}
+    for kv in args:
+        if "=" in kv:
+            k, _, v = kv.partition("=")
+            cmd[k] = _coerce(v)
+    return cmd
 
 
 def _build_command(args: list[str]) -> dict:
@@ -236,6 +245,25 @@ def _build_command(args: list[str]) -> dict:
                 )
             return {"prefix": "crash archive", "id": args[2]}
         raise SystemExit(f"unknown crash subcommand {sub!r}")
+    if args[0] == "tracing":
+        # mgr-targeted: tracing dump [qos_class=X] | tracing summary
+        sub = args[1] if len(args) > 1 else "summary"
+        cmd = {"prefix": f"tracing {sub}"}
+        for kv in args[2:]:
+            if "=" in kv:
+                k, _, v = kv.partition("=")
+                cmd[k] = v
+        return cmd
+    if args[0] == "slo":
+        # mgr-targeted (routed to the active mgr by main()):
+        # slo status | slo targets | slo targets set SPEC...
+        if len(args) >= 3 and args[1] == "targets" and args[2] == "set":
+            return {
+                "prefix": "slo targets set",
+                "targets": " ".join(args[3:]),
+            }
+        sub = args[1] if len(args) > 1 else "status"
+        return {"prefix": f"slo {sub}"}
     if args[0] in ("status", "health"):
         return {"prefix": args[0]}
     # pass-through: let the monitor reject unknowns (same as the
@@ -281,7 +309,11 @@ def main(argv=None) -> int:
         mc.connect(host, int(port))
         cmd = _build_command(args.command)
         prefix = cmd["prefix"]
-        if prefix == "crash" or prefix.startswith("crash "):
+        if prefix == "slo" or prefix.startswith(("slo ", "tracing ")):
+            # mgr-module commands, like crash: the owning module
+            # (first prefix word) serves them on the active mgr
+            reply = _mgr_command(msgr, mc, cmd)
+        elif prefix == "crash" or prefix.startswith("crash "):
             # mgr-module command: discover the active mgr through the
             # monitor and send there (the reference CLI routes
             # MgrCommands to the active mgr the same way)

@@ -44,7 +44,7 @@ def _sample_messages():
             pool=7, pgid="7.3", oid="obj-1", op=M.OSD_OP_WRITE,
             offset=4096, length=11, data=b"hello world",
             attr="k", reqid="client.9", epoch=42, snapid=5,
-            snap_seq=6, flags=M.OSD_FLAG_FULL_TRY,
+            snap_seq=6, flags=M.OSD_FLAG_FULL_TRY, qos="gold",
         ),
         "MOSDOpReply": M.MOSDOpReply(
             ok=True, error="", data=b"payload", names=["a", "b"],
@@ -230,6 +230,35 @@ def _build_types():
         return e.getvalue()
 
     types["objectstore_transaction"] = (txn_build, txn_roundtrip)
+
+    # latency-histogram snapshots (the SLO plane's wire/artifact
+    # shapes, common/histogram.py): the 1D log2 histogram and the 2D
+    # latency×size grid both pin their binary snapshot encoding
+    from ..common.histogram import LogHistogram, PerfHistogram2D
+
+    def hist_sample() -> LogHistogram:
+        h = LogHistogram()
+        for v in (1e-5, 3e-4, 3e-4, 0.002, 0.05, 1.7, 900.0, 1e9):
+            h.add(v)
+        return h
+
+    def grid_sample() -> PerfHistogram2D:
+        g = PerfHistogram2D()
+        for lat, size in (
+            (1e-4, 4096.0), (0.003, 65536.0), (0.2, 1.0),
+            (9.0, 1 << 26),
+        ):
+            g.add(lat, size)
+        return g
+
+    types["perf_histogram"] = (
+        lambda: hist_sample().encode(),
+        lambda blob: LogHistogram.decode(blob).encode(),
+    )
+    types["perf_histogram_2d"] = (
+        lambda: grid_sample().encode(),
+        lambda blob: PerfHistogram2D.decode(blob).encode(),
+    )
     return types
 
 

@@ -327,6 +327,191 @@ def product_event_samples() -> list[str]:
     return errors
 
 
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)$"
+)
+_LABEL_PAIR_RE = re.compile(
+    r'\s*(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\]|\\.)*)"\s*(?:,|$)'
+)
+
+
+def check_prometheus_histograms(text: str) -> list[str]:
+    """Lint rendered exposition text for histogram-family
+    correctness: one HELP/TYPE per family, cumulative bucket
+    monotonicity per labelset, a closing ``le="+Inf"`` bucket that
+    equals ``_count``, a ``_sum``/``_count`` pair per labelset, and
+    label-name safety.  Fed the exporter's real output in tier-1."""
+    errors: list[str] = []
+    types: dict[str, str] = {}
+    helped: set[str] = set()
+    # (family, labels-without-le) -> [(le, value)] in document order
+    buckets: dict[tuple[str, tuple], list[tuple[str, float]]] = {}
+    sums: set[tuple[str, tuple]] = set()
+    counts: dict[tuple[str, tuple], float] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            fam = parts[2] if len(parts) > 2 else ""
+            if fam in types:
+                errors.append(f"line {lineno}: duplicate TYPE {fam}")
+            types[fam] = parts[3] if len(parts) > 3 else ""
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split()
+            fam = parts[2] if len(parts) > 2 else ""
+            if fam in helped:
+                errors.append(f"line {lineno}: duplicate HELP {fam}")
+            helped.add(fam)
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"line {lineno}: unparseable sample {line!r}")
+            continue
+        name = m.group("name")
+        labels: dict[str, str] = {}
+        raw = m.group("labels") or ""
+        pos = 0
+        while pos < len(raw):
+            lm = _LABEL_PAIR_RE.match(raw, pos)
+            if lm is None:
+                errors.append(
+                    f"line {lineno}: bad label syntax {raw!r}"
+                )
+                break
+            labels[lm.group("k")] = lm.group("v")
+            pos = lm.end()
+        for k in labels:
+            if not _LABEL_NAME_RE.match(k):
+                errors.append(f"line {lineno}: bad label name {k!r}")
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            errors.append(
+                f"line {lineno}: non-numeric value "
+                f"{m.group('value')!r}"
+            )
+            continue
+        for suffix, sink in (
+            ("_bucket", "bucket"), ("_sum", "sum"), ("_count", "count"),
+        ):
+            fam = name[: -len(suffix)] if name.endswith(suffix) else None
+            if fam and types.get(fam) == "histogram":
+                key = (
+                    fam,
+                    tuple(
+                        sorted(
+                            (k, v)
+                            for k, v in labels.items()
+                            if k != "le"
+                        )
+                    ),
+                )
+                if sink == "bucket":
+                    if "le" not in labels:
+                        errors.append(
+                            f"line {lineno}: bucket without le"
+                        )
+                    buckets.setdefault(key, []).append(
+                        (labels.get("le", ""), value)
+                    )
+                elif sink == "sum":
+                    sums.add(key)
+                else:
+                    counts[key] = value
+                break
+    for fam, typ in types.items():
+        if typ != "histogram":
+            continue
+        fam_keys = [k for k in buckets if k[0] == fam]
+        if not fam_keys:
+            errors.append(f"{fam}: histogram family with no buckets")
+        for key in fam_keys:
+            rows = buckets[key]
+            vals = [v for _le, v in rows]
+            if any(b > a for a, b in zip(vals[1:], vals)):
+                errors.append(
+                    f"{fam}{dict(key[1])}: buckets not monotone"
+                )
+            if not rows or rows[-1][0] != "+Inf":
+                errors.append(
+                    f"{fam}{dict(key[1])}: no closing +Inf bucket"
+                )
+            elif key in counts and rows[-1][1] != counts[key]:
+                errors.append(
+                    f"{fam}{dict(key[1])}: +Inf bucket "
+                    f"{rows[-1][1]} != _count {counts[key]}"
+                )
+            if key not in sums:
+                errors.append(f"{fam}{dict(key[1])}: missing _sum")
+            if key not in counts:
+                errors.append(f"{fam}{dict(key[1])}: missing _count")
+    return errors
+
+
+def product_histogram_exposition() -> list[str]:
+    """Render histogram families through the mgr exporter's REAL
+    renderer from product-generated histograms (op tracker
+    completions + a commit histogram) and lint the text."""
+    from ceph_tpu.common.histogram import LogHistogram
+    from ceph_tpu.common.op_tracker import OpTracker
+    from ceph_tpu.mgr import histogram_exposition_lines
+
+    tracker = OpTracker()
+    for qos, typ, n in (
+        ("client", "write", 3), ("client", "read", 2),
+        ("gold", "write", 1),
+    ):
+        for _ in range(n):
+            op = tracker.create_op(
+                "lint probe", op_type=typ, qos_class=qos
+            )
+            op.mark_event("started")
+            op.finish()
+    commit = LogHistogram()
+    for v in (1e-4, 2e-3, 0.5):
+        commit.add(v)
+    lines: list[str] = []
+    series = [
+        (
+            {
+                "ceph_daemon": "osd.0",
+                "qos_class": key.split(".")[1],
+                "op_type": key.split(".")[2],
+            },
+            snap,
+        )
+        for key, snap in sorted(
+            tracker.histogram_perf_entries().items()
+        )
+    ]
+    lines.extend(
+        histogram_exposition_lines(
+            "ceph_osd_op_latency_seconds",
+            "op completion latency by qos class and op type",
+            series,
+        )
+    )
+    lines.extend(
+        histogram_exposition_lines(
+            "ceph_daemon_commit_lat_hist_seconds",
+            "commit latency",
+            [({"ceph_daemon": "osd.0"}, commit.snapshot())],
+        )
+    )
+    text = "\n".join(lines) + "\n"
+    errors = check_prometheus_histograms(text)
+    if "le=\"+Inf\"" not in text:
+        errors.append("exporter output carries no +Inf bucket at all")
+    return errors
+
+
 def check_perf_counters(pc) -> list[str]:
     """Lint one PerfCounters set; returns human-readable errors."""
     from ceph_tpu.common.perf_counters import PERFCOUNTER_HISTOGRAM
@@ -396,11 +581,13 @@ def check_all(sets=None) -> list[str]:
             cross.add(key)
     if lint_events:
         # product mode (no explicit sets): also lint the event-plane
-        # and scrub-plane schemas the daemons really emit
+        # and scrub-plane schemas the daemons really emit, and the
+        # exporter's native histogram rendering
         errors.extend(product_event_samples())
         errors.extend(product_scrub_samples())
         errors.extend(check_scrub_counters())
         errors.extend(check_fault_counters())
+        errors.extend(product_histogram_exposition())
     return errors
 
 
