@@ -148,6 +148,16 @@ SCHEMA: dict[str, Option] = {
             min=1,
         ),
         Option(
+            "osd_tpu_batch_max",
+            OPT_INT,
+            16,
+            "queued same-pool client writes the OSD worker drains "
+            "into one coalesced device encode dispatch (1 disables "
+            "write coalescing)",
+            min=1,
+            level=LEVEL_BASIC,
+        ),
+        Option(
             "perf_enabled",
             OPT_BOOL,
             True,

@@ -27,6 +27,15 @@ class NumpyBackend:
     def matrix_regions(
         self, matrix: np.ndarray, regions: np.ndarray, w: int
     ) -> np.ndarray:
+        if w == 8:
+            # C region-MAC fast path (native/gf8.c, the
+            # jerasure/ISA-L pshufb hot loop): bit-exact with the
+            # numpy fallback below; None when no compiler exists
+            from ..native import gf8_matrix_regions
+
+            out = gf8_matrix_regions(matrix, regions)
+            if out is not None:
+                return out
         return matrix_vector_mul_region(matrix, regions, w)
 
     def matrix_stripes(
@@ -36,8 +45,19 @@ class NumpyBackend:
         region byte dimension (same layout as the jax backend)."""
         stripes = np.ascontiguousarray(stripes, dtype=np.uint8)
         b, _k, chunk = stripes.shape
-        out = matrix_vector_mul_region(matrix, fold_stripes(stripes), w)
+        out = self.matrix_regions(matrix, fold_stripes(stripes), w)
         return unfold_stripes(out, b, chunk)
+
+    def matrix_stripes_batch(
+        self, matrix: np.ndarray, stripe_batches, w: int
+    ) -> list[np.ndarray]:
+        """Coalesced-encode seam (the jax backend double-buffers
+        device transfers here); the oracle just loops — coalescing is
+        a dispatch-cost optimization, and the oracle has no dispatch
+        cost to amortize."""
+        return [
+            self.matrix_stripes(matrix, s, w) for s in stripe_batches
+        ]
 
     def bitmatrix_regions(
         self,

@@ -71,6 +71,48 @@ def _kstats():
     return kernel_stats()
 
 
+def _matrix_fast_path(ec, needs: str):
+    """The ONE eligibility gate for the batched matrix device path
+    (shared by encode and encode_batch so the two can never drift):
+    returns (matrix, backend, ok) where ok means the code family's
+    whole-word matrix math is safe to batch AND the backend has the
+    ``needs`` entry point.  Bitmatrix techniques
+    (cauchy/liberation/blaum_roth) carry a .matrix too, but encode
+    through XOR schedules over packet planes — the word-wise matrix
+    path would corrupt them; chunk remapping likewise bails."""
+    matrix = getattr(ec, "matrix", None)
+    backend = getattr(ec, "backend", None)
+    ok = (
+        matrix is not None
+        and getattr(ec, "bitmatrix", None) is None
+        and backend is not None
+        and hasattr(backend, needs)
+        and not ec.get_chunk_mapping()
+    )
+    return matrix, backend, ok
+
+
+def _assemble_shards(
+    stripes: np.ndarray, coding: np.ndarray, k: int, n: int, want=None
+) -> dict[int, np.ndarray]:
+    """(B, k, chunk) data stripes + (B, m, chunk) coding → the
+    per-shard concatenated-chunk dict — the ONE layout assembly both
+    encode and encode_batch share (byte identity between the two
+    rests on there being a single copy of this)."""
+    out: dict[int, np.ndarray] = {}
+    for i in range(k):
+        if want is None or i in want:
+            out[i] = np.ascontiguousarray(
+                stripes[:, i, :]
+            ).reshape(-1)
+    for j in range(n - k):
+        if want is None or k + j in want:
+            out[k + j] = np.ascontiguousarray(
+                coding[:, j, :]
+            ).reshape(-1)
+    return out
+
+
 def encode(
     sinfo: StripeInfo, ec, data: bytes | np.ndarray, want=None
 ) -> dict[int, np.ndarray]:
@@ -96,31 +138,11 @@ def encode(
         return {}
 
     with _kstats().timed("ec_encode", bytes_in=buf.nbytes) as kt:
-        matrix = getattr(ec, "matrix", None)
-        backend = getattr(ec, "backend", None)
-        if (
-            matrix is not None
-            # bitmatrix techniques (cauchy/liberation/blaum_roth) carry a
-            # .matrix too, but encode through XOR schedules over packet
-            # planes — the word-wise matrix path would corrupt them
-            and getattr(ec, "bitmatrix", None) is None
-            and backend is not None
-            and hasattr(backend, "matrix_stripes")
-            and not ec.get_chunk_mapping()
-        ):
+        matrix, backend, ok = _matrix_fast_path(ec, "matrix_stripes")
+        if ok:
             stripes = buf.reshape(nstripes, k, sinfo.chunk_size)
             coding = backend.matrix_stripes(matrix, stripes, ec.w)
-            out = {}
-            for i in range(k):
-                if i in want:
-                    out[i] = np.ascontiguousarray(
-                        stripes[:, i, :]
-                    ).reshape(-1)
-            for j in range(n - k):
-                if k + j in want:
-                    out[k + j] = np.ascontiguousarray(
-                        coding[:, j, :]
-                    ).reshape(-1)
+            out = _assemble_shards(stripes, coding, k, n, want)
         else:
             parts = {i: [] for i in range(n)}
             for s in range(nstripes):
@@ -137,6 +159,68 @@ def encode(
             }
         kt.bytes_out = sum(v.nbytes for v in out.values())
         return out
+
+
+def encode_batch(
+    sinfo: StripeInfo, ec, buffers
+) -> list[dict[int, np.ndarray]]:
+    """Coalesced multi-object encode: every buffer's stripes ride ONE
+    pipelined device pass (``matrix_stripes_batch`` — async
+    double-buffered transfers, sync at the end) instead of one
+    dispatch per object.  Byte-identical to per-buffer :func:`encode`
+    by construction (same per-stripe math), proven in
+    tests/test_residency.py.  Falls back to the per-buffer loop for
+    layered/bitmatrix codes or single-object batches.
+
+    Each coalesced dispatch counts in
+    ``l_tpu_batch_encode_{dispatches,ops_per_dispatch}``.
+    """
+    bufs = [
+        np.frombuffer(bytes(b), dtype=np.uint8)
+        if isinstance(b, (bytes, bytearray, memoryview))
+        else np.ascontiguousarray(b, dtype=np.uint8).ravel()
+        for b in buffers
+    ]
+    for buf in bufs:
+        if len(buf) % sinfo.stripe_width:
+            raise ErasureCodeError(
+                f"logical size {len(buf)} not stripe aligned"
+            )
+    n = ec.get_chunk_count()
+    k = ec.get_data_chunk_count()
+    matrix, backend, ok = _matrix_fast_path(
+        ec, "matrix_stripes_batch"
+    )
+    if not ok or len(bufs) < 2:
+        return [encode(sinfo, ec, buf) for buf in bufs]
+
+    stripe_arrays = [
+        buf.reshape(
+            len(buf) // sinfo.stripe_width, k, sinfo.chunk_size
+        )
+        for buf in bufs
+    ]
+    ks = _kstats()
+    from ..ops.residency import ensure_counters
+
+    ensure_counters(ks)
+    total = sum(buf.nbytes for buf in bufs)
+    with ks.timed("ec_encode", bytes_in=total) as kt:
+        codings = backend.matrix_stripes_batch(
+            matrix, stripe_arrays, ec.w
+        )
+        ks.perf.inc("l_tpu_batch_encode_dispatches")
+        ks.perf.inc("l_tpu_batch_encode_ops_per_dispatch", len(bufs))
+        out: list[dict[int, np.ndarray]] = []
+        for stripes, coding in zip(stripe_arrays, codings):
+            if stripes.shape[0] == 0:
+                out.append({})
+                continue
+            out.append(_assemble_shards(stripes, coding, k, n))
+        kt.bytes_out = sum(
+            v.nbytes for shards in out for v in shards.values()
+        )
+    return out
 
 
 def decode_concat(

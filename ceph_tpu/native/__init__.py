@@ -14,33 +14,63 @@ import pathlib
 import subprocess
 import tempfile
 
-_SRC = pathlib.Path(__file__).parent / "crc32c.c"
+_SOURCES = [
+    pathlib.Path(__file__).parent / "crc32c.c",
+    pathlib.Path(__file__).parent / "gf8.c",
+]
 
 
 @functools.lru_cache(maxsize=1)
 def _lib():
     """Build (once per user cache) and load the native library; None if
     no C compiler works here.  Private 0700 cache dir + write-then-
-    rename keep a shared host from injecting or racing the build."""
+    rename keep a shared host from injecting or racing the build.
+
+    ISA policy: ``-mssse3`` on x86 unlocks the pshufb GF region
+    kernel (universal on x86-64 silicon since ~2006) — NOT
+    ``-march=native``, whose AVX-512-class output would SIGILL when a
+    shared $HOME hands the cached .so to an older node; the cache
+    file is keyed by machine arch for the same reason.  Compilers
+    that reject the flag retry with plain -O3 (scalar loops)."""
+    import platform
+
     build = (
         pathlib.Path.home() / ".cache" / "ceph_tpu" / "native"
     )
     build.mkdir(parents=True, exist_ok=True, mode=0o700)
-    so = build / "libceph_tpu_crc32c.so"
+    arch = platform.machine() or "unknown"
+    so = build / f"libceph_tpu_native_{arch}.so"
     try:
-        if not so.exists() or so.stat().st_mtime < _SRC.stat().st_mtime:
+        src_mtime = max(s.stat().st_mtime for s in _SOURCES)
+        if not so.exists() or so.stat().st_mtime < src_mtime:
             with tempfile.NamedTemporaryFile(
                 dir=build, suffix=".so", delete=False
             ) as tmp:
                 tmp_path = pathlib.Path(tmp.name)
-            subprocess.run(
-                [
-                    "cc", "-O3", "-shared", "-fPIC",
-                    str(_SRC), "-o", str(tmp_path),
-                ],
-                check=True,
-                capture_output=True,
+            srcs = [str(s) for s in _SOURCES]
+            flags = (
+                ["-O3", "-mssse3"]
+                if arch in ("x86_64", "i686", "AMD64")
+                else ["-O3"]
             )
+            try:
+                subprocess.run(
+                    [
+                        "cc", *flags, "-shared",
+                        "-fPIC", *srcs, "-o", str(tmp_path),
+                    ],
+                    check=True,
+                    capture_output=True,
+                )
+            except subprocess.CalledProcessError:
+                subprocess.run(
+                    [
+                        "cc", "-O3", "-shared", "-fPIC",
+                        *srcs, "-o", str(tmp_path),
+                    ],
+                    check=True,
+                    capture_output=True,
+                )
             tmp_path.replace(so)
         lib = ctypes.CDLL(str(so))
         lib.ceph_crc32c.restype = ctypes.c_uint32
@@ -49,8 +79,21 @@ def _lib():
             ctypes.c_char_p,
             ctypes.c_size_t,
         ]
+        try:
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            lib.gf8_region_mac.restype = None
+            lib.gf8_region_mac.argtypes = [
+                u8p, u8p, u8p, ctypes.c_size_t,
+            ]
+            lib.gf8_region_xor.restype = None
+            lib.gf8_region_xor.argtypes = [u8p, u8p, ctypes.c_size_t]
+        except AttributeError:
+            # a stale cached .so without the gf8 symbols: crc32c
+            # still serves; gf callers see the missing attribute and
+            # keep their numpy path
+            pass
         return lib
-    except (OSError, subprocess.CalledProcessError):
+    except (OSError, subprocess.CalledProcessError, AttributeError):
         return None
 
 
@@ -73,6 +116,43 @@ def _py_table():
             ) & 0xFFFFFFFF
         table.append(rev32(c))
     return table
+
+
+def gf8_matrix_regions(matrix, regions):
+    """GF(2^8) coding-matrix apply over byte regions through the C
+    region-MAC kernel (the jerasure_matrix_encode / ec_encode_data
+    hot loop): returns the (m, nbytes) uint8 parity regions, or None
+    when no native library is available (callers keep the numpy
+    path).  Bit-exact with gf.matrix_vector_mul_region — the pure-
+    python oracle stays the independent reference."""
+    import numpy as np
+
+    lib = _lib()
+    if lib is None or not hasattr(lib, "gf8_region_mac"):
+        return None
+    from ..gf.arith import _byte_table8
+
+    regions = np.ascontiguousarray(regions, dtype=np.uint8)
+    m, k = matrix.shape
+    n = regions.shape[1]
+    out = np.zeros((m, n), dtype=np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    for i in range(m):
+        out_p = out[i].ctypes.data_as(u8p)
+        for j in range(k):
+            c = int(matrix[i, j])
+            if c == 0:
+                continue
+            in_p = regions[j].ctypes.data_as(u8p)
+            if c == 1:
+                lib.gf8_region_xor(in_p, out_p, n)
+            else:
+                table = _byte_table8(c)
+                lib.gf8_region_mac(
+                    in_p, out_p,
+                    table.ctypes.data_as(u8p), n,
+                )
+    return out
 
 
 def ceph_crc32c(crc: int, data: bytes | memoryview) -> int:

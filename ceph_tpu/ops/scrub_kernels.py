@@ -246,26 +246,70 @@ def _kstats():
     return kernel_stats()
 
 
-def _pad_rows(buffers) -> tuple[np.ndarray, list[int]]:
-    """Right-align every buffer into one (n, L) uint8 array, L a
-    _CHUNK multiple (leading zeros are crc-neutral with a zero
-    register, so alignment costs nothing)."""
-    lens = [len(b) for b in buffers]
-    longest = max(lens) if lens else 0
-    padded = max(_CHUNK, -(-longest // _CHUNK) * _CHUNK)
-    arr = np.zeros((len(lens), padded), dtype=np.uint8)
-    for i, buf in enumerate(buffers):
-        if lens[i]:
-            arr[i, padded - lens[i]:] = np.frombuffer(
-                bytes(buf), dtype=np.uint8
+def _gather_rows(entries, width: int, *, align_right: bool, fillers: int = 0):
+    """Build an (len(entries) + fillers, width) uint8 DEVICE matrix
+    from mixed host-bytes / DeviceBuf entries — the ONE pad/stack/
+    permute implementation both device kernels share: every host row
+    (plus the zero filler rows) rides a single bulk ``device_put``,
+    resident rows pad device-side (no second transfer), and one
+    permutation gather restores entry order (fillers land after the
+    real rows).  All-host batches skip the gather entirely."""
+    import jax
+    import jax.numpy as jnp
+
+    from .residency import DeviceBuf
+
+    n = len(entries)
+    host_idx = [
+        i for i, e in enumerate(entries)
+        if not isinstance(e, DeviceBuf)
+    ]
+    res_idx = [
+        i for i, e in enumerate(entries) if isinstance(e, DeviceBuf)
+    ]
+    block = np.zeros((len(host_idx) + fillers, width), dtype=np.uint8)
+    for r, i in enumerate(host_idx):
+        raw = bytes(entries[i])
+        if raw:
+            if align_right:
+                block[r, width - len(raw):] = np.frombuffer(
+                    raw, dtype=np.uint8
+                )
+            else:
+                block[r, : len(raw)] = np.frombuffer(
+                    raw, dtype=np.uint8
+                )
+    dev_block = jax.device_put(block)
+    if not res_idx:
+        return dev_block  # already in entry order, fillers trailing
+    res_rows = jnp.stack(
+        [
+            jnp.pad(
+                entries[i].device(),
+                (width - len(entries[i]), 0)
+                if align_right
+                else (0, width - len(entries[i])),
             )
-    return arr, lens
+            for i in res_idx
+        ]
+    )
+    perm = np.empty(n + fillers, dtype=np.int32)
+    for r, i in enumerate(host_idx):
+        perm[i] = r
+    for f in range(fillers):
+        perm[n + f] = len(host_idx) + f
+    base = len(host_idx) + fillers
+    for r, i in enumerate(res_idx):
+        perm[i] = base + r
+    return jnp.concatenate([dev_block, res_rows])[jnp.asarray(perm)]
 
 
 def _oracle(buffers, inits) -> np.ndarray:
+    from .residency import as_host_bytes
+
     return np.array(
         [
-            ceph_crc32c(init, bytes(buf))
+            ceph_crc32c(init, as_host_bytes(buf))
             for buf, init in zip(buffers, inits)
         ],
         dtype=np.uint32,
@@ -281,6 +325,11 @@ def batch_crc32c(
     running-crc semantics; the EC HashInfo convention seeds with
     0xffffffff).  ``backend``: None = device with oracle fallback,
     "device" = device or raise, "oracle" = the native C loop.
+
+    Entries may be host bytes OR ``ops.residency.DeviceBuf`` tokens —
+    a resident buffer (e.g. a shard the EC write path just encoded)
+    is consumed where it already lives instead of paying a second
+    host→device transfer per stage.
     """
     buffers = list(buffers)
     if not buffers:
@@ -300,21 +349,29 @@ def batch_crc32c(
 
 
 def _device_crc32c(buffers, inits) -> np.ndarray:
-    import jax
+    from .residency import bucket_pow2, note_shape
 
     _self_check()
-    arr, lens = _pad_rows(buffers)
-    n, padded = arr.shape
+    lens = [len(b) for b in buffers]
+    n = len(buffers)
+    padded = _CHUNK * bucket_pow2(-(-max(max(lens), 1) // _CHUNK))
     nchunks = padded // _CHUNK
+    nrows = bucket_pow2(n)
     ks = _kstats()
-    with ks.timed("scrub_crc32c", bytes_in=arr.nbytes) as kt:
+    with ks.timed("scrub_crc32c", bytes_in=sum(lens)) as kt:
         gc = ks.counted_cache_call(_device_chunk_matrix, _CHUNK)
         hc = ks.counted_cache_call(
             _device_combine_matrix, _CHUNK, nchunks
         )
         call = _crc_call(_CHUNK, nchunks)
-        rows = jax.device_put(arr.reshape(n, nchunks, _CHUNK))
-        out = np.asarray(call(rows, gc, hc)).astype(np.uint32)
+        note_shape("scrub_crc32c", nrows, nchunks)
+        # resident payloads right-align ON DEVICE (no second
+        # host→device transfer); host payloads + the pow2 filler rows
+        # (which crc to 0 and slice away) ride ONE bulk device_put
+        rows = _gather_rows(
+            buffers, padded, align_right=True, fillers=nrows - n
+        ).reshape(nrows, nchunks, _CHUNK)
+        out = np.asarray(call(rows, gc, hc)).astype(np.uint32)[:n]
         kt.bytes_out = out.nbytes
     # per-object init fold: crc = data_term ⊕ L^len(init)
     for i, (ln, init) in enumerate(zip(lens, inits)):
@@ -338,9 +395,17 @@ def batch_compare(stored, expected, *, backend: str | None = None):
     """Per-pair any-byte-differs verdict (bool array) — the device
     side of re-encode verification: ``stored[i]`` is the shard bytes
     on disk, ``expected[i]`` the re-encoded truth.  Length mismatches
-    are verdicts on their own (no device trip needed for them)."""
-    stored = [bytes(s) for s in stored]
-    expected = [bytes(e) for e in expected]
+    are verdicts on their own (no device trip needed for them).
+
+    Entries in either list may be host bytes or
+    ``ops.residency.DeviceBuf`` tokens — resident shard payloads are
+    compared where they already live (no second ``device_put`` of
+    bytes the EC path just uploaded); the compare width buckets to a
+    power of two so ragged verify chunks replay compiled programs."""
+    from .residency import as_host_bytes, bucket_pow2, note_shape
+
+    stored = list(stored)
+    expected = list(expected)
     assert len(stored) == len(expected)
     if not stored:
         return np.zeros(0, dtype=bool)
@@ -357,27 +422,31 @@ def batch_compare(stored, expected, *, backend: str | None = None):
     width = max(len(stored[i]) for i in same_len)
     if width == 0:
         return out
-    a = np.zeros((len(same_len), width), dtype=np.uint8)
-    b = np.zeros((len(same_len), width), dtype=np.uint8)
-    for row, i in enumerate(same_len):
-        a[row, : len(stored[i])] = np.frombuffer(
-            stored[i], dtype=np.uint8
-        )
-        b[row, : len(expected[i])] = np.frombuffer(
-            expected[i], dtype=np.uint8
-        )
+    bwidth = bucket_pow2(width)
+
+    def _host_rows(seq) -> np.ndarray:
+        rows = np.zeros((len(same_len), bwidth), dtype=np.uint8)
+        for row, i in enumerate(same_len):
+            raw = as_host_bytes(seq[i])
+            rows[row, : len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+        return rows
+
     if backend != "oracle":
         try:
-            import jax
-
             ks = _kstats()
-            with ks.timed(
-                "scrub_verify", bytes_in=a.nbytes + b.nbytes
-            ) as kt:
+            total = sum(2 * len(stored[i]) for i in same_len)
+            with ks.timed("scrub_verify", bytes_in=total) as kt:
+                a_dev = _gather_rows(
+                    [stored[i] for i in same_len], bwidth,
+                    align_right=False,
+                )
+                b_dev = _gather_rows(
+                    [expected[i] for i in same_len], bwidth,
+                    align_right=False,
+                )
+                note_shape("scrub_verify", len(same_len), bwidth)
                 verdict = np.asarray(
-                    _compare_call(width)(
-                        jax.device_put(a), jax.device_put(b)
-                    )
+                    _compare_call(bwidth)(a_dev, b_dev)
                 )
                 kt.bytes_out = verdict.nbytes
             out[same_len] = verdict
@@ -385,5 +454,7 @@ def batch_compare(stored, expected, *, backend: str | None = None):
         except Exception:  # noqa: BLE001 — fall through to numpy
             if backend == "device":
                 raise
+    a = _host_rows(stored)
+    b = _host_rows(expected)
     out[same_len] = (a != b).any(axis=1)
     return out

@@ -389,6 +389,12 @@ class OSD(Dispatcher):
             # a stray CEPH_TPU_* env var must not kill the daemon
             dout("osd", 0, f"osd.{whoami}: ignoring bad env config: {e}")
         self.op_tracker = OpTracker()
+        # write coalescing (ROADMAP item 1): the worker drains up to
+        # this many queued same-pool full-object writes per dispatch
+        # and encodes them as ONE batched device call (1 disables)
+        self.osd_tpu_batch_max = int(
+            self.config.get("osd_tpu_batch_max")
+        )
         # distributed tracing (common/tracing.py): per-stage spans
         # under the client reqid, drained onto the MMgrReport push
         self.tracer = tracing.Tracer(
@@ -1229,7 +1235,9 @@ class OSD(Dispatcher):
             return "list"
         return "write"
 
-    def _handle_op(self, conn: Connection, msg: MOSDOp) -> None:
+    def _handle_op(
+        self, conn: Connection, msg: MOSDOp, pre_encoded=None
+    ) -> None:
         t0 = time.perf_counter()
         qos_class = self._qos_class_of(msg)
         op_type = self._op_type_of(msg.op)
@@ -1256,7 +1264,7 @@ class OSD(Dispatcher):
         )
         try:
             with span:
-                self._handle_op_inner(conn, msg)
+                self._handle_op_inner(conn, msg, pre_encoded)
         finally:
             self._cur_op = None
             top.finish()
@@ -1278,7 +1286,9 @@ class OSD(Dispatcher):
             return False
         return osdmap.is_blocklisted(reqid.rsplit(".", 1)[0])
 
-    def _handle_op_inner(self, conn: Connection, msg: MOSDOp) -> None:
+    def _handle_op_inner(
+        self, conn: Connection, msg: MOSDOp, pre_encoded=None
+    ) -> None:
         epoch = self.monc.epoch
         pg = self.pgs.get(msg.pgid)
         reply = MOSDOpReply(tid=msg.tid, epoch=epoch)
@@ -1411,7 +1421,9 @@ class OSD(Dispatcher):
                     if o.startswith(OBJ_PREFIX) and "@" not in o
                 )
             else:
-                self._mutate(pg, epoch, msg, store_oid)
+                self._mutate(
+                    pg, epoch, msg, store_oid, pre_encoded=pre_encoded
+                )
                 if (
                     tiered
                     and msg.op == OSD_OP_DELETE
@@ -1781,13 +1793,24 @@ class OSD(Dispatcher):
             omap_fn=omap_fn,
         )
 
-    def _mutate(self, pg: PG, epoch: int, msg: MOSDOp, store_oid: str):
+    def _mutate(
+        self,
+        pg: PG,
+        epoch: int,
+        msg: MOSDOp,
+        store_oid: str,
+        pre_encoded=None,
+    ):
         """Append a log entry + apply data in ONE transaction, fan the
         same transaction to the acting peers (issue_repop).  Raises
         StoreError to surface op errors; replica failures surface as
-        -EAGAIN so the client retries after the interval changes."""
+        -EAGAIN so the client retries after the interval changes.
+        ``pre_encoded`` is a coalesced-dispatch (shards, meta) pair
+        for this op's payload (EC WRITEFULL only)."""
         if self._is_ec(pg):
-            return self._mutate_ec(pg, epoch, msg, store_oid)
+            return self._mutate_ec(
+                pg, epoch, msg, store_oid, pre_encoded=pre_encoded
+            )
         if msg.reqid and msg.reqid in pg.reqid_cache:
             # retried op already applied (osd_reqid_t dedup; the cache
             # outlives log trimming, like the log's dups) — replay the
@@ -1939,6 +1962,16 @@ class OSD(Dispatcher):
         out = self._commit_and_replicate(
             pg, epoch, msg, entry, txn_by_osd, outdata
         )
+        if msg.op == OSD_OP_WRITEFULL:
+            # the committed payload IS the object's full content:
+            # register it device-resident so a deep scrub digests it
+            # without a second host→device upload (ops/residency.py;
+            # any later txn on the object invalidates by generation)
+            from ..ops.residency import residency_cache
+
+            residency_cache().put_committed(
+                self.store, pg.cid, store_oid, data=msg.data
+            )
         if ctx is not None:
             for payload in ctx.notifies:
                 # post-commit, fire-and-forget (cls_cxx_notify)
@@ -2042,7 +2075,14 @@ class OSD(Dispatcher):
         self._maybe_trim(pg)
         return outdata
 
-    def _mutate_ec(self, pg: PG, epoch: int, msg: MOSDOp, store_oid: str):
+    def _mutate_ec(
+        self,
+        pg: PG,
+        epoch: int,
+        msg: MOSDOp,
+        store_oid: str,
+        pre_encoded=None,
+    ):
         """Erasure-pool mutation: encode the new logical object and fan
         one per-position transaction (shard + HashInfo + log entry +
         info) down the same MOSDRepOp path replicated pools use
@@ -2100,13 +2140,25 @@ class OSD(Dispatcher):
                 raise StoreError(str(e))
 
         txns: dict[int, Transaction] = {}
+        my_shard: list = []  # [bytes] when a full encode ran
 
         def encode_all(new_data: bytes, extra_attrs=None) -> None:
-            shards, meta = codec.encode_object(new_data)
+            if (
+                pre_encoded is not None
+                and msg.op == OSD_OP_WRITEFULL
+                and new_data is msg.data
+            ):
+                # coalesced dispatch already encoded this payload
+                # (byte-identical to encode_object; tests prove it)
+                shards, meta = pre_encoded
+            else:
+                shards, meta = codec.encode_object(new_data)
             for pos, _osd in present:
                 txns[pos] = shard_write_txn(
                     pg.cid, store_oid, shards[pos], meta, extra_attrs
                 )
+                if _osd == self.whoami:
+                    my_shard[:] = [shards[pos]]
 
         def remove_all() -> None:
             for pos, _osd in present:
@@ -2258,6 +2310,16 @@ class OSD(Dispatcher):
         out = self._commit_and_replicate(
             pg, epoch, msg, entry, txn_by_osd, outdata
         )
+        if my_shard:
+            # our position's freshly committed shard stays resident:
+            # the deep-scrub crc32c and the re-encode verify of this
+            # object consume it without re-paying the link
+            # (generation-invalidated by any later txn)
+            from ..ops.residency import residency_cache
+
+            residency_cache().put_committed(
+                self.store, pg.cid, store_oid, data=my_shard[0]
+            )
         if ctx is not None:
             for payload in ctx.notifies:
                 self._notify_watchers(pg, msg.oid, payload, timeout=0)
@@ -2961,6 +3023,95 @@ class OSD(Dispatcher):
                 if not watchers:
                     del self._watchers[key]
 
+    # -- write coalescing (ROADMAP item 1's batched dispatch) --------------
+    def _coalesce_op_items(self, item) -> list:
+        """After dequeuing an EC full-object write, drain up to
+        ``osd_tpu_batch_max - 1`` more CONSECUTIVE same-pool
+        WRITEFULLs from the SAME QoS class queue (the reference's
+        op-shard batching shape, OSDMapMapping.h:18's amortize-the-
+        setup lesson applied to the link): they ride one batched
+        encode dispatch while every op still dedups, commits,
+        replicates, traces, and replies individually, in queue order
+        — per-class QoS ordering is untouched because only the head
+        run of the class that was ALREADY being served drains."""
+        if self.osd_tpu_batch_max <= 1:
+            return []
+        msg = item[2]
+        if msg.op != OSD_OP_WRITEFULL or not msg.data:
+            return []
+        pg = self.pgs.get(msg.pgid)
+        if (
+            pg is None
+            or pg.primary != self.whoami
+            or pg.state != "active"
+            or not self._is_ec(pg)
+        ):
+            return []
+        klass = self._workq.last_class()
+        if not klass or klass == CLASS_STRICT:
+            return []
+        pool_prefix = msg.pgid.split(".", 1)[0] + "."
+
+        def matches(it) -> bool:
+            # cheap + lock-free: runs under the scheduler lock
+            return (
+                isinstance(it, tuple)
+                and len(it) == 4
+                and it[0] == "op"
+                and it[2].op == OSD_OP_WRITEFULL
+                and bool(it[2].data)
+                and it[2].pgid.startswith(pool_prefix)
+            )
+
+        return self._workq.drain_class(
+            klass, matches, self.osd_tpu_batch_max - 1
+        )
+
+    def _handle_op_batch(self, items: list) -> None:
+        """Serve a coalesced batch: ONE batched encode dispatch
+        (ECCodec.encode_object_batch → the pipelined device pass with
+        double-buffered transfers), then each op runs its normal
+        per-op path with its shards precomputed — dedup/snap/log/
+        replication/reply semantics unchanged, completions fan back
+        out per op in queue order."""
+        pre: dict[int, tuple] = {}
+        pg = self.pgs.get(items[0][2].pgid)
+        if pg is not None:
+            try:
+                codec = self._ec_codec(pg)
+                encs = codec.encode_object_batch(
+                    [it[2].data for it in items]
+                )
+                pre = {
+                    id(it[2]): enc for it, enc in zip(items, encs)
+                }
+            except Exception:  # noqa: BLE001 — coalescing is an
+                # optimization: a batch-encode failure degrades every
+                # op to its own per-op encode, never drops it
+                pre = {}
+        for it in items:
+            try:
+                self._handle_op(
+                    it[1], it[2], pre_encoded=pre.get(id(it[2]))
+                )
+            except Exception as e:  # noqa: BLE001 — one op's death
+                # must not drop the rest of the drained batch (their
+                # clients would never get a reply) nor leak their
+                # throttle tickets; capture it exactly like the
+                # worker loop's catch-all does
+                import traceback
+
+                traceback.print_exc()
+                crash_util.capture(
+                    f"osd.{self.whoami}",
+                    e,
+                    sink=self._pending_crashes,
+                    clog=self.clog,
+                    extra_meta={"work_item": "op(coalesced)"},
+                )
+            finally:
+                self.client_throttle.put(it[3])
+
     # -- worker / ticker ---------------------------------------------------
     def _work_loop(self) -> None:
         while not self._stop.is_set():
@@ -2972,10 +3123,14 @@ class OSD(Dispatcher):
                 if kind == "map":
                     self._walk_pgs(item[1])
                 elif kind == "op":
-                    try:
-                        self._handle_op(item[1], item[2])
-                    finally:
-                        self.client_throttle.put(item[3])
+                    extra = self._coalesce_op_items(item)
+                    if extra:
+                        self._handle_op_batch([item] + extra)
+                    else:
+                        try:
+                            self._handle_op(item[1], item[2])
+                        finally:
+                            self.client_throttle.put(item[3])
                 elif kind == "activate":
                     self._apply_activate(item[1], item[2])
                 elif kind == "pull":

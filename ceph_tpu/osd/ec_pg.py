@@ -34,7 +34,6 @@ from ..ec import ErasureCodeProfile, registry_instance
 from ..ec.stripe import (
     HashInfo,
     StripeInfo,
-    encode as stripe_encode,
     rmw_encode,
 )
 from ..store.objectstore import ObjectStore, StoreError, Transaction
@@ -46,6 +45,8 @@ DEFAULT_STRIPE_UNIT = 4096  # osd_pool_erasure_code_stripe_unit role
 class UnreachableStore(ObjectStore):
     """A shard position with nobody behind it (down OSD or
     CRUSH_ITEM_NONE hole): every access fails like a dead peer."""
+
+    residency_local = False
 
     def _fail(self, *_a, **_kw):
         raise StoreError("shard unreachable (down or hole)")
@@ -83,22 +84,46 @@ class ECCodec:
     ) -> tuple[dict[int, bytes], dict]:
         """Full-object encode: pad to stripe multiples, run the stripe
         seam, compute per-shard HashInfo.  Returns ({pos: shard_bytes},
-        meta) with meta in the shard-xattr JSON shape ECStore reads."""
-        logical = len(data)
-        padded_len = self.sinfo.logical_to_next_stripe_offset(logical)
-        padded = data + b"\0" * (padded_len - logical)
-        shards = stripe_encode(self.sinfo, self.ec, padded)
-        if not shards:  # zero-length object: n empty shards
-            shards = {
-                i: np.zeros(0, dtype=np.uint8) for i in range(self.n)
+        meta) with meta in the shard-xattr JSON shape ECStore reads.
+        ONE implementation serves both paths: this is the
+        single-element case of the batch (encode_batch runs a
+        1-element batch through the same per-buffer encode)."""
+        return self.encode_object_batch([data])[0]
+
+    def encode_object_batch(
+        self, datas
+    ) -> list[tuple[dict[int, bytes], dict]]:
+        """Batched :meth:`encode_object`: every queued payload's
+        stripes ride ONE pipelined device pass (the write-coalescing
+        seam — ec/stripe.encode_batch with async double-buffered
+        transfers underneath), byte-identical to per-object encodes.
+        Returns one ({pos: shard_bytes}, meta) per payload, in
+        order."""
+        from ..ec.stripe import encode_batch
+
+        padded = []
+        for data in datas:
+            logical = len(data)
+            plen = self.sinfo.logical_to_next_stripe_offset(logical)
+            padded.append(bytes(data) + b"\0" * (plen - logical))
+        shard_sets = encode_batch(self.sinfo, self.ec, padded)
+        out: list[tuple[dict[int, bytes], dict]] = []
+        for data, shards in zip(datas, shard_sets):
+            if not shards:  # zero-length object: n empty shards
+                shards = {
+                    i: np.zeros(0, dtype=np.uint8)
+                    for i in range(self.n)
+                }
+            hinfo = HashInfo(self.n)
+            hinfo.append(0, shards)
+            meta = {
+                "size": len(data),
+                "hashes": hinfo.cumulative_shard_hashes,
             }
-        hinfo = HashInfo(self.n)
-        hinfo.append(0, shards)
-        meta = {
-            "size": logical,
-            "hashes": hinfo.cumulative_shard_hashes,
-        }
-        return {i: bytes(shards[i]) for i in range(self.n)}, meta
+            out.append(
+                ({i: bytes(shards[i]) for i in range(self.n)}, meta)
+            )
+        return out
 
 
 def rmw_write_txns(

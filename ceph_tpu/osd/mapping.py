@@ -164,16 +164,29 @@ class OSDMapMapping:
         if use_device:
             try:
                 from ..crush import jaxmap
+                from ..ops.residency import bucket_pow2, note_shape
                 from .sharded_mapping import mesh_batch_do_rule
 
                 cm = _compiled(osdmap.crush)
+                # bucket the PG batch to a power of two (pad with a
+                # repeat of lane 0 — a valid input — and slice the
+                # rows back) so pools with ragged pg_num and remap
+                # sweeps replay ONE compiled program per bucket;
+                # reuse lands in l_tpu_compile_cache_{hit,miss}
+                nb = bucket_pow2(n)
+                pps_in = pps
+                if nb != n:
+                    pps_in = np.concatenate(
+                        [pps, np.full(nb - n, pps[0], dtype=pps.dtype)]
+                    )
+                note_shape("crush_batch", nb, pool.size)
                 # shards across the device mesh when >1 device exists
                 # (ParallelPGMapper role); single-device unchanged
                 res, counts = mesh_batch_do_rule(
-                    cm, ruleno, pps, pool.size, osdmap.osd_weight
+                    cm, ruleno, pps_in, pool.size, osdmap.osd_weight
                 )
-                raw = np.asarray(res, dtype=np.int64)
-                counts = np.asarray(counts)
+                raw = np.asarray(res, dtype=np.int64)[:n]
+                counts = np.asarray(counts)[:n]
                 # positions beyond the returned count are absent, not NONE
                 cols = np.arange(pool.size)
                 return np.where(cols[None, :] < counts[:, None], raw, _NONE)

@@ -84,6 +84,44 @@ class _SchedulerBase:
         dmclock profile) for ``klass``."""
         return klass in self._queues
 
+    def last_class(self) -> str | None:
+        """The class the most recent dequeue served (single-consumer
+        worker loops use this to coalesce follow-on work from the
+        same class)."""
+        return self.class_log[-1] if self.class_log else None
+
+    def drain_class(self, klass: str, predicate, max_n: int) -> list:
+        """Write-coalescing hook: pop up to ``max_n`` CONSECUTIVE
+        head items of ``klass``'s queue that satisfy ``predicate``
+        (first non-match stops the drain — skipping over it would
+        reorder the class's stream, and per-object ordering is the
+        invariant batching must keep).  The drained items ride the
+        dispatch the caller is already committing, so their costs are
+        still charged (subclass hook) — cross-class fairness is
+        perturbed by at most one bounded burst, exactly like the
+        reference's op-shard batching.  ``predicate`` runs under the
+        scheduler lock: it must be cheap and lock-free."""
+        out: list = []
+        with self._cond:
+            q = self._queues.get(klass)
+            if not q:
+                return out
+            while q and len(out) < max_n:
+                entry = q[0]
+                item = entry[-1]
+                if not predicate(item):
+                    break
+                q.popleft()
+                self._size -= 1
+                self._drained(klass, entry)
+                self.class_log.append(klass)
+                out.append(item)
+        return out
+
+    def _drained(self, klass: str, entry) -> None:
+        """Cost accounting for an item drained outside dequeue()
+        (default: none — dmclock tags advanced at enqueue)."""
+
     def qlen(self) -> int:
         with self._lock:
             return self._size
@@ -128,6 +166,13 @@ class WeightedPriorityQueue(_SchedulerBase):
     def _enqueue_weighted(self, klass: str, cost: int, item) -> None:
         self._queues[klass].append((cost, item))
 
+    def _drained(self, klass: str, entry) -> None:
+        # charge the drained item's cost; credit may go negative, so
+        # the class yields the worker longer afterwards — fairness
+        # holds over time even though the burst ran now
+        if klass in self._credit:
+            self._credit[klass] -= entry[0]
+
     def dequeue(self, timeout: float | None = None):
         with self._cond:
             while self._size == 0:
@@ -150,7 +195,11 @@ class WeightedPriorityQueue(_SchedulerBase):
                 klass = self._rr[self._rr_pos]
                 q = self._queues[klass]
                 if not q:
-                    self._credit[klass] = 0.0
+                    # clear UNUSED positive credit, but keep drain
+                    # DEBT (negative, from coalesced bursts): a class
+                    # that repeatedly empties its queue between
+                    # bursts must still pay for them
+                    self._credit[klass] = min(self._credit[klass], 0.0)
                     self._rr_pos = (self._rr_pos + 1) % n
                     self._fresh = True
                     spins += 1
@@ -166,7 +215,9 @@ class WeightedPriorityQueue(_SchedulerBase):
                     q.popleft()
                     self._credit[klass] -= cost
                     if not q:
-                        self._credit[klass] = 0.0
+                        self._credit[klass] = min(
+                            self._credit[klass], 0.0
+                        )
                     self.class_log.append(klass)
                     return item
                 self._rr_pos = (self._rr_pos + 1) % n
@@ -180,7 +231,7 @@ class WeightedPriorityQueue(_SchedulerBase):
                 if q
             )
             cost, item = self._queues[best[1]].popleft()
-            self._credit[best[1]] = 0.0
+            self._credit[best[1]] = min(self._credit[best[1]], 0.0)
             self.class_log.append(best[1])
             return item
 

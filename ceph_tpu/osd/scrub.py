@@ -90,13 +90,30 @@ def _digest(parts: dict[str, bytes]) -> int:
     return crc
 
 
+def _resident(store, cid: str, oid: str, expect_len=None):
+    """Generation-checked residency lookup (ops/residency.py): a hit
+    is the payload the last committed txn landed, already on device —
+    the deep-scrub digest of a freshly written object costs zero
+    host→device transfer.  Only scrub-trusted stores are consulted:
+    proxies mutate out of our sight, and persistent media (whose
+    out-of-band bit rot is exactly what deep scrub audits) must be
+    READ, never served from cache."""
+    from ..ops.residency import residency_cache, scrub_trusted
+
+    if not scrub_trusted(store):
+        return None
+    return residency_cache().get(store, cid, oid, expect_len=expect_len)
+
+
 def build_scrub_map(
     store, cid: str, oids, deep: bool, with_hinfo: bool = False
 ) -> dict[str, dict]:
     """One daemon's digest map over a chunk of store oids (the
     ScrubMap role, src/osd/scrubber_common.h): size + omap/xattr
     digests always, payload crc32c when ``deep`` (ALL payloads of the
-    chunk in one batched device call)."""
+    chunk in one batched device call; device-RESIDENT payloads — the
+    bytes the EC/replicated write path just committed — digest with
+    no re-upload)."""
     out: dict[str, dict] = {}
     datas: list[bytes] = []
     data_oids: list[str] = []
@@ -128,7 +145,10 @@ def build_scrub_map(
                 except (KeyError, ValueError):
                     ent["hinfo"] = None
             if deep:
-                datas.append(store.read(cid, oid))
+                buf = _resident(store, cid, oid, ent["size"])
+                datas.append(
+                    buf if buf is not None else store.read(cid, oid)
+                )
                 data_oids.append(oid)
             out[oid] = ent
         except StoreError:
@@ -897,11 +917,14 @@ class Scrubber:
             except (ErasureCodeError, StoreError):
                 continue
             for pos in range(codec.n):
-                try:
-                    raw = ecs.stores[pos].read(pg.cid, oid)
-                except StoreError:
-                    continue
-                stored.append(raw)
+                st = ecs.stores[pos]
+                buf = _resident(st, pg.cid, oid)
+                if buf is None:
+                    try:
+                        buf = st.read(pg.cid, oid)
+                    except StoreError:
+                        continue
+                stored.append(buf)
                 expect.append(bytes(shards.get(pos, b"")))
                 where.append((oid, pos))
         if not stored:

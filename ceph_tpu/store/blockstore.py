@@ -384,6 +384,9 @@ class BlockStore(ObjectStore):
 
     # -- transaction path --------------------------------------------------
     def queue_transaction(self, txn: Transaction) -> None:
+        from .objectstore import residency_gens
+
+        residency_gens.note_txn(self, txn)
         with self._lock:
             st = _BTxn(self)
             committed = False

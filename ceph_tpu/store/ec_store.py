@@ -336,6 +336,13 @@ class ECStore:
         txn.write(self.cid, name, 0, shard)
         txn.setattr(self.cid, name, HINFO_KEY, json.dumps(meta).encode())
         store.queue_transaction(txn)
+        # register AFTER the txn (the entry records the post-txn
+        # generation; any later txn on the shard invalidates it)
+        from ..ops.residency import residency_cache
+
+        residency_cache().put_committed(
+            store, self.cid, name, data=shard
+        )
 
     # -- read path ---------------------------------------------------------
     def _shard_meta(self, name: str) -> dict:
@@ -428,6 +435,10 @@ class ECStore:
         crc loop; hinfo-less objects still take the per-object
         re-encode fallback.  Findings are identical to scrub() by
         construction (same hashes, same compare)."""
+        from ..ops.residency import (
+            residency_cache,
+            scrub_trusted as _scrub_trusted,
+        )
         from ..ops.scrub_kernels import batch_crc32c
 
         results: dict[str, ScrubResult] = {}
@@ -445,14 +456,31 @@ class ECStore:
                     continue  # absent everywhere: nothing to audit
                 metas[name] = meta
                 raws[name] = {}
+                has_hashes = meta.get("hashes") is not None
                 for i, store in enumerate(self.stores):
+                    if has_hashes and _scrub_trusted(store):
+                        # generation-checked residency: a hit is the
+                        # shard the last committed txn landed, already
+                        # on device — zero-transfer digest.  Any txn
+                        # since registration (overwrite, delete,
+                        # injected corruption) misses and the disk
+                        # read below is audited instead.  Persistent
+                        # media is never served from cache (deep
+                        # scrub audits its out-of-band rot).
+                        buf = residency_cache().get(
+                            store, self.cid, name
+                        )
+                        if buf is not None:
+                            bufs.append(buf)
+                            where.append((name, i))
+                            continue
                     try:
                         raw = store.read(self.cid, name)
                     except StoreError:
                         result.missing.append(i)
                         continue
                     raws[name][i] = raw
-                    if meta.get("hashes") is not None:
+                    if has_hashes:
                         bufs.append(raw)
                         where.append((name, i))
             if bufs:

@@ -82,6 +82,9 @@ class KStore(MemStore):
         # validate + apply under the memstore lock, but WAL-append
         # first: an entry is only written once the ops are known to
         # apply cleanly, so we shadow-apply, then log, then commit.
+        from .objectstore import residency_gens
+
+        residency_gens.note_txn(self, txn)
         with self._lock:
             from .objectstore import _TxnState
 

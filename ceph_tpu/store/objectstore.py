@@ -22,6 +22,99 @@ class StoreError(Exception):
     pass
 
 
+class ResidencyGens:
+    """Per-(store, cid, oid) mutation generations — the invalidation
+    spine of the device-payload residency cache (ops/residency.py).
+
+    Every concrete ``queue_transaction`` notes its transaction here
+    BEFORE applying, so a device-resident copy of an object registered
+    at generation g can never serve a digest once ANY transaction —
+    client write, recovery push, or an injected bit-rot txn — has
+    named that object (its generation moved past g and the cache
+    lookup misses).  Conservative by construction: a failed
+    transaction still bumps, which only costs a re-upload.
+
+    The map is bounded: on overflow the whole table clears and a
+    global epoch bumps, which invalidates every outstanding residency
+    entry at once (generations are (epoch, counter) pairs).
+    """
+
+    MAX_ENTRIES = 1 << 20
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._epoch = 0
+        self._gens: dict[tuple, int] = {}
+        self._tokens = 0
+        self._tls = threading.local()
+
+    def store_token(self, store) -> int:
+        """A process-unique id for a store instance (id() can be
+        recycled by the allocator after GC; this never is)."""
+        tok = getattr(store, "_residency_token", None)
+        if tok is None:
+            with self._lock:
+                tok = getattr(store, "_residency_token", None)
+                if tok is None:
+                    self._tokens += 1
+                    tok = self._tokens
+                    store._residency_token = tok
+        return tok
+
+    def note_txn(self, store, txn: "Transaction") -> None:
+        tok = self.store_token(store)
+        # per-THREAD record of the generations this txn assigned: the
+        # writer that queued the txn registers its payload against
+        # exactly these (txn_gen below), so a concurrent thread's
+        # later txn — which assigns a HIGHER generation — can never
+        # be absorbed into the registration (the lookup would compare
+        # against the newer generation and miss).  Bounded; consumed
+        # by txn_gen.
+        pend = getattr(self._tls, "pending", None)
+        if pend is None or len(pend) > 256:
+            pend = {}
+            self._tls.pending = pend
+        with self._lock:
+            for op in txn.ops:
+                kind = op[0]
+                if kind in ("mkcoll", "rmcoll"):
+                    # rmcoll requires an empty collection, so every
+                    # object was already bumped by its own removal
+                    continue
+                # clone mutates the DESTINATION object
+                oid = op[3] if kind == "clone" else op[2]
+                key = (tok, op[1], oid)
+                self._gens[key] = self._gens.get(key, 0) + 1
+                pend[key] = (self._epoch, self._gens[key])
+            if len(self._gens) > self.MAX_ENTRIES:
+                self._gens.clear()
+                self._epoch += 1
+
+    def txn_gen(self, store, cid: str, oid: str):
+        """The generation THIS THREAD's own transaction assigned to
+        (cid, oid), or None if no such txn is recorded — consumed on
+        read.  Registering a payload against this (rather than the
+        CURRENT generation) closes the commit-to-register window: a
+        racing writer's txn lands a higher generation, so the entry
+        registered here simply misses."""
+        pend = getattr(self._tls, "pending", None)
+        if not pend:
+            return None
+        return pend.pop(
+            (self.store_token(store), cid, oid), None
+        )
+
+    def gen_of(self, store, cid: str, oid: str) -> tuple[int, int]:
+        tok = self.store_token(store)
+        with self._lock:
+            return (self._epoch, self._gens.get((tok, cid, oid), 0))
+
+
+# process-global: one invalidation spine, like the one JAX runtime the
+# resident buffers themselves live in
+residency_gens = ResidencyGens()
+
+
 @dataclass
 class _Object:
     data: bytearray = field(default_factory=bytearray)
@@ -101,6 +194,21 @@ class ObjectStore:
     # tests shrink it to exercise full/nearfull handling; concrete
     # stores may override statfs with a cheaper accounting
     total_bytes = 1 << 30
+
+    # device-payload residency (ops/residency.py) registers entries
+    # only against stores whose mutations all flow through THIS
+    # process's queue_transaction — proxies (RemoteStore) set False:
+    # the backing object mutates on the remote daemon's own store,
+    # which the proxy's generation counter cannot observe
+    residency_local = True
+    # whether DEEP SCRUB may digest a resident copy in place of a
+    # media read.  Default False: on persistent media (BlockStore) a
+    # byte can rot WITHOUT a transaction, and the scrub exists to
+    # catch exactly that — it must read the media.  In-memory stores
+    # (MemStore) set True: their read() serves the same txn-observed
+    # state the generation spine tracks, so the resident copy and the
+    # "media" cannot diverge out-of-band.
+    residency_scrub_safe = False
 
     def queue_transaction(self, txn: Transaction) -> None:
         raise NotImplementedError
@@ -216,12 +324,19 @@ class MemStore(ObjectStore):
     """RAM ObjectStore (src/os/memstore/) with per-object
     copy-on-write transaction shadows."""
 
+    # in-memory: read() and the resident copy cannot diverge without
+    # a transaction, so scrub may digest residency (see base class)
+    residency_scrub_safe = True
+
     def __init__(self):
         self._lock = lockdep.Mutex("memstore")
         self._colls: dict[str, dict[str, _Object]] = {}
 
     # -- transactions ------------------------------------------------------
     def queue_transaction(self, txn: Transaction) -> None:
+        # residency invalidation BEFORE the apply: a device-resident
+        # copy must stop matching the moment this txn names the object
+        residency_gens.note_txn(self, txn)
         with self._lock:
             st = _TxnState(self)
             for op in txn.ops:

@@ -210,6 +210,11 @@ class RemoteStore(ObjectStore):
             raise StoreError(f"unexpected reply {type(reply).__name__}")
         return reply
 
+    # proxy: the backing store mutates on the remote daemon; our
+    # generation counter cannot see those txns, so residency entries
+    # must never key on this object
+    residency_local = False
+
     # -- write -------------------------------------------------------------
     def queue_transaction(self, txn: Transaction) -> None:
         reply = self._call(

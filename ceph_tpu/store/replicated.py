@@ -105,6 +105,13 @@ class ReplicatedStore:
                         json.dumps(meta).encode(),
                     )
                     store.queue_transaction(txn)
+                    # register AFTER the txn (it bumped the object's
+                    # generation; the entry records the post-txn gen)
+                    from ..ops.residency import residency_cache
+
+                    residency_cache().put_committed(
+                        store, self.cid, name, data=data
+                    )
                     sp.mark_event(f"replica_{i}_applied")
         finally:
             self._exit(name, ticket)
@@ -211,6 +218,10 @@ class ReplicatedStore:
         (ops/scrub_kernels.batch_crc32c); digest-less objects keep
         the per-object majority-content compare.  Findings are
         identical to scrub() by construction."""
+        from ..ops.residency import (
+            residency_cache,
+            scrub_trusted as _scrub_trusted,
+        )
         from ..ops.scrub_kernels import batch_crc32c
 
         results: dict[str, ScrubResult] = {}
@@ -227,6 +238,22 @@ class ReplicatedStore:
                 digest = meta.get("digest")
                 raws: dict[int, bytes] = {}
                 for i, store in enumerate(self.stores):
+                    if digest is not None and _scrub_trusted(store):
+                        # generation-checked residency: a hit is the
+                        # payload the last committed txn landed —
+                        # digest it where it already lives (no second
+                        # host→device transfer); any txn since
+                        # registration (including injected bit rot)
+                        # misses and falls through to the disk read;
+                        # persistent media is never served from cache
+                        buf = residency_cache().get(
+                            store, self.cid, name,
+                            expect_len=meta["size"],
+                        )
+                        if buf is not None:
+                            bufs.append(buf)
+                            where.append((name, i, digest))
+                            continue
                     try:
                         raws[i] = store.read(self.cid, name)
                     except StoreError:

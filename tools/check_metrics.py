@@ -74,6 +74,17 @@ FULLNESS_COUNTERS = (
     "stat_bytes", "stat_bytes_used", "stat_bytes_avail",
     "backoffs_active",
 )
+# device-residency + coalesced-encode families the kernel-stats
+# schema must declare (ops/residency.py ensure_counters — the
+# data-plane batching observability the e2e_batched bench reads)
+RESIDENCY_COUNTERS = (
+    "l_tpu_residency_hits",
+    "l_tpu_residency_misses",
+    "l_tpu_residency_evictions",
+    "l_tpu_residency_bytes_resident",
+    "l_tpu_batch_encode_dispatches",
+    "l_tpu_batch_encode_ops_per_dispatch",
+)
 
 CRASH_REQUIRED = (
     "crash_id", "entity_name", "timestamp", "timestamp_iso",
@@ -306,6 +317,24 @@ def check_fault_counters() -> list[str]:
         if name not in osd_declared
     )
     return errors
+
+
+def check_residency_counters() -> list[str]:
+    """The kernel-stats schema must keep declaring the residency and
+    batched-encode families through the REAL registration helper
+    (ops/residency.ensure_counters — the exact names the e2e_batched
+    bench and the MMgrReport pipeline read)."""
+    from ceph_tpu.ops.kernel_stats import KernelStats
+    from ceph_tpu.ops.residency import ensure_counters
+
+    ks = KernelStats()
+    ensure_counters(ks)
+    declared = set(ks.perf._counters)
+    return [
+        f"kernel schema: residency counter {name!r} missing"
+        for name in RESIDENCY_COUNTERS
+        if name not in declared
+    ]
 
 
 def product_event_samples() -> list[str]:
@@ -552,12 +581,17 @@ def product_counter_sets():
     from ceph_tpu.osd.daemon import build_osd_perf
     from ceph_tpu.osd.mapping import _build_perf as build_mapping_perf
 
+    from ceph_tpu.ops.residency import ensure_counters
+
     ks = KernelStats()
     # force-register every group the instrumented modules use
     for group in ("ec_encode", "ec_decode", "gf_matmul",
                   "gf_bitmatrix", "crush"):
         ks.record(group)
     ks.counter("crush", "pgs")
+    # residency + coalesced-encode families (ops/residency.py) join
+    # the schema walk and the cross-set collision lint
+    ensure_counters(ks)
     return [
         build_osd_perf(0), build_mapping_perf(), ks.perf,
         build_msgr_perf("osd.0"),
@@ -587,6 +621,7 @@ def check_all(sets=None) -> list[str]:
         errors.extend(product_scrub_samples())
         errors.extend(check_scrub_counters())
         errors.extend(check_fault_counters())
+        errors.extend(check_residency_counters())
         errors.extend(product_histogram_exposition())
     return errors
 
