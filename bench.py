@@ -1170,6 +1170,163 @@ def measure_scrub() -> dict:
     }
 
 
+def measure_recovery(on_tpu: bool) -> dict:
+    """Recovery-storm plane (ROADMAP open item 2): decode-from-
+    survivors rebuild throughput before/after the coalesced batched
+    dispatch, recovery-read fan-in before/after LRC locality
+    (MEASURED from minimum_to_decode-driven survivor reads, not
+    claimed), and — through tests/chaos.py's kill-OSD-at-80%-full
+    scenario — the client p99 + gold-class mclock floor verdict
+    while a live rebuild storms.  Entirely CPU-measurable: a down
+    TPU tunnel degrades to the host kernels under the artifact's
+    ``tpu_unavailable`` marker, like ``--slo``."""
+    from ceph_tpu.store.ec_store import ECStore
+
+    profile = {
+        "plugin": "jerasure", "technique": "reed_sol_van",
+        "k": str(K), "m": str(M), "w": str(W),
+    }
+    if on_tpu:
+        profile["backend"] = "jax"
+    obj_size = OBJECT_SIZE if on_tpu else 256 << 10
+    nobj = 32 if on_tpu else 12
+    rng = np.random.default_rng(23)
+    dead = 2  # the rebuilt position (a data shard: the worst case)
+
+    def build(prof, plugin="jerasure", n=nobj):
+        ecs = ECStore(plugin=plugin, profile=prof)
+        datas = {}
+        for i in range(n):
+            d = rng.integers(
+                0, 256, size=obj_size, dtype=np.uint8
+            ).tobytes()
+            datas[f"rec{i}"] = d
+            ecs.put(f"rec{i}", d)
+        return ecs, datas
+
+    ecs, datas = build({k: v for k, v in profile.items() if k != "plugin"})
+    names = list(datas)
+
+    # identity gate: the batched rebuild must land byte-identical
+    # shards to the per-op path before any number is reported
+    probe = names[:3]
+    for nm in probe:
+        ecs.lose_shard(nm, dead)
+    per_op_shards = {}
+    for nm in probe:
+        # reconstruct WITHOUT writing: the shard stays lost, so the
+        # batched pass below rebuilds the very same objects
+        data, _reads, meta = ecs.reconstruct_shard(nm, dead)
+        per_op_shards[nm] = data
+    results, fb, _stats = ecs.reconstruct_shards_batch(probe, dead)
+    if fb:
+        raise AssertionError(f"batched rebuild fell back: {fb}")
+    for nm in probe:
+        payload, _meta = results[nm]
+        got = payload.host() if hasattr(payload, "host") else bytes(payload)
+        if got != per_op_shards[nm]:
+            raise AssertionError(
+                "batched rebuild disagrees with per-op rebuild"
+            )
+    for nm in probe:
+        ecs.recover_shard(nm, dead)
+
+    def lose_all():
+        for nm in names:
+            ecs.lose_shard(nm, dead)
+
+    # per-op rebuild (the pre-batching regime: one decode per object)
+    lose_all()
+    t0 = time.perf_counter()
+    for nm in names:
+        ecs.recover_shard(nm, dead)
+    per_op_dt = time.perf_counter() - t0
+    per_op_gbs = nobj * obj_size / per_op_dt / 2**30
+
+    # batched rebuild: ONE coalesced decode-from-survivors dispatch
+    lose_all()
+    t0 = time.perf_counter()
+    stats = ecs.recover_objects_batch(names, dead)
+    batched_dt = time.perf_counter() - t0
+    batched_gbs = nobj * obj_size / batched_dt / 2**30
+    k8_fanin = stats["survivor_shards"] / max(stats["objects"], 1)
+    for nm, d in datas.items():
+        if ecs.get(nm) != d:
+            raise AssertionError(f"{nm} corrupted by batched rebuild")
+    _log(
+        f"recovery[k{K}m{M}]: per-op {per_op_gbs:.3f} GB/s, batched "
+        f"{batched_gbs:.3f} GB/s ({nobj}x{obj_size >> 10}KB, fan-in "
+        f"{k8_fanin:.1f} shards/object)"
+    )
+
+    # LRC locality: the SAME rebuild reads k_local << k survivors
+    lrc_prof = {"k": "6", "m": "3", "l": "3"}
+    if on_tpu:
+        lrc_prof["backend"] = "jax"
+    lecs, ldatas = build(lrc_prof, plugin="lrc", n=nobj // 2)
+    lnames = list(ldatas)
+    for nm in lnames:
+        lecs.lose_shard(nm, 0)
+    t0 = time.perf_counter()
+    lstats = lecs.recover_objects_batch(lnames, 0)
+    lrc_dt = time.perf_counter() - t0
+    lrc_fanin = lstats["survivor_shards"] / max(lstats["objects"], 1)
+    for nm, d in ldatas.items():
+        if lecs.get(nm) != d:
+            raise AssertionError(f"lrc {nm} corrupted by rebuild")
+    _log(
+        f"recovery[lrc k6m3 l3]: fan-in {lrc_fanin:.1f} "
+        f"shards/object vs {k8_fanin:.1f} without locality, "
+        f"{len(lnames) * obj_size / lrc_dt / 2**30:.3f} GB/s"
+    )
+
+    out = {
+        "recovery": {
+            "profile": f"k{K}m{M}",
+            "objects": nobj,
+            "object_bytes": obj_size,
+            "per_op_GBps": round(per_op_gbs, 3),
+            "batched_GBps": round(batched_gbs, 3),
+            "fanin_shards_per_object": round(k8_fanin, 2),
+            "lrc": {
+                "profile": "k6 m3 l3",
+                "fanin_shards_per_object": round(lrc_fanin, 2),
+                "read_bytes": lstats["read_bytes"],
+                "GBps": round(
+                    len(lnames) * obj_size / lrc_dt / 2**30, 3
+                ),
+            },
+        },
+        "recovery_batched_GBps": round(batched_gbs, 3),
+        "recovery_lrc_fanin": round(lrc_fanin, 2),
+    }
+
+    # live storm: client p99 + the gold-class mclock floor while a
+    # kill-OSD-at-80%-full rebuild drains (tests/chaos.py scenario —
+    # CPU-side, in-process cluster; its own failure degrades to an
+    # error marker instead of eating the section)
+    try:
+        import pathlib
+        import sys as _sys
+
+        _sys.path.insert(
+            0, str(pathlib.Path(__file__).parent / "tests")
+        )
+        import chaos
+
+        storm = chaos.scenario_kill_osd_at_fill()
+        out["recovery"]["storm"] = storm
+        out["recovery_client_p99_ms"] = storm["slo"]["storm_p99_ms"]
+        out["recovery_floor_held"] = storm["slo"]["held"]
+    except Exception as e:  # noqa: BLE001 — the micro numbers above
+        # still ship when the live-cluster storm dies under CI load
+        import traceback
+
+        traceback.print_exc()
+        out["recovery"]["storm"] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
 def measure_mesh(
     device_counts=None,
     pgs: int | None = None,
@@ -1521,6 +1678,10 @@ def main(argv=None) -> None:
                 ),
                 ("crush", measure_crush),
                 ("scrub", measure_scrub),
+                (
+                    "recovery",
+                    lambda: measure_recovery(on_tpu),
+                ),
             ]
             if _mesh_devices() > 1:
                 # multi-chip host (or virtual mesh): the scaling curve

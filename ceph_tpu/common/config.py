@@ -158,6 +158,16 @@ SCHEMA: dict[str, Option] = {
             level=LEVEL_BASIC,
         ),
         Option(
+            "osd_recovery_batch_max",
+            OPT_INT,
+            16,
+            "queued same-peer recovery pushes the OSD worker drains "
+            "into one coalesced decode-from-survivors dispatch (1 "
+            "disables recovery batching)",
+            min=1,
+            level=LEVEL_BASIC,
+        ),
+        Option(
             "perf_enabled",
             OPT_BOOL,
             True,

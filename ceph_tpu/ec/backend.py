@@ -21,6 +21,17 @@ from ..gf import matrix_vector_mul_region
 from ..layout import fold_stripes, unfold_stripes
 
 
+def _host_row(r) -> np.ndarray:
+    """1-D uint8 view of a survivor payload: DeviceBuf tokens fetch
+    host-side, bytes-likes go through frombuffer (ascontiguousarray
+    would parse bytes as a scalar literal)."""
+    if hasattr(r, "host"):
+        r = r.host()
+    if isinstance(r, (bytes, bytearray, memoryview)):
+        return np.frombuffer(bytes(r), dtype=np.uint8)
+    return np.ascontiguousarray(r, dtype=np.uint8).ravel()
+
+
 class NumpyBackend:
     name = "numpy"
 
@@ -58,6 +69,27 @@ class NumpyBackend:
         return [
             self.matrix_stripes(matrix, s, w) for s in stripe_batches
         ]
+
+    def decode_stripes_batch(
+        self, matrix: np.ndarray, row_sets, w: int, chunk: int
+    ) -> list[np.ndarray]:
+        """Batched decode-from-survivors seam (the jax backend
+        double-buffers uploads and keeps outputs device-born here).
+        ``row_sets`` is one list per object of equal-length 1-D
+        survivor payloads (ndarray or DeviceBuf — resident tokens
+        fetch host-side on this oracle path); each reshapes to
+        (nstripes, s, chunk) and multiplies by the reconstruction
+        matrix.  The oracle loops — it has no dispatch cost to
+        amortize — through the same C region-MAC fast path the
+        encode side uses."""
+        outs: list[np.ndarray] = []
+        for rows in row_sets:
+            arr = np.stack(
+                [_host_row(r).reshape(-1, chunk) for r in rows],
+                axis=1,
+            )
+            outs.append(self.matrix_stripes(matrix, arr, w))
+        return outs
 
     def bitmatrix_regions(
         self,

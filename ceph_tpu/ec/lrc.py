@@ -308,6 +308,66 @@ class ErasureCodeLrc(ErasureCode):
             f"{sorted(want_to_read)} (-EIO)"
         )
 
+    # -- batched repair ----------------------------------------------------
+    def decode_matrix(self, want_to_read, available):
+        """The batched-repair plan (ec/stripe.decode_reconstruction
+        hook): when ONE layer's local group covers every wanted chunk
+        and its erasures fit that layer's coding count, the repair is
+        the inner matrix code's solve over k_local ≪ k survivors —
+        LRC's locality carried onto the coalesced device dispatch.
+        Returns (rows, survivors, w, backend) in GLOBAL positions;
+        raises ErasureCodeError when no single matrix layer solves it
+        (the caller falls back to the layered per-object decode)."""
+        from .stripe import _matrix_fast_path, reconstruction_rows
+
+        want = set(want_to_read)
+        available = set(available)
+        for layer in reversed(self.layers):
+            if not want <= layer.chunks_as_set:
+                continue
+            inner = layer.erasure_code
+            avail_local = {
+                j
+                for j, c in enumerate(layer.chunks)
+                if c in available
+            }
+            if len(layer.chunks) - len(avail_local) > (
+                inner.get_coding_chunk_count()
+            ):
+                continue
+            matrix, backend, ok = _matrix_fast_path(
+                inner, "decode_stripes_batch"
+            )
+            if not ok:
+                continue
+            k_l, w = inner.get_data_chunk_count(), inner.w
+            # the SAME row composition the flat families use
+            # (stripe.reconstruction_rows), just run in layer-local
+            # indices — then the rows re-order to the GLOBAL sorted
+            # want (layer.chunks need not be globally monotonic) and
+            # the survivors translate back to global positions
+            want_local = {layer.chunks.index(p) for p in want}
+            rows_local, surv_local = reconstruction_rows(
+                matrix, want_local, avail_local, k_l, w
+            )
+            order = sorted(want_local)
+            rows = [
+                rows_local[order.index(layer.chunks.index(p))]
+                for p in sorted(want)
+            ]
+            return (
+                np.array(rows, dtype=np.int64).reshape(
+                    len(rows), k_l
+                ),
+                [layer.chunks[s] for s in surv_local],
+                w,
+                backend,
+            )
+        raise ErasureCodeError(
+            f"no single layer rebuilds {sorted(want)} from "
+            f"{sorted(available)} as matrix math"
+        )
+
     # -- crush -------------------------------------------------------------
     def create_rule(self, name: str, crush, ss=None) -> int:
         """Custom layered rule from rule_steps (ErasureCodeLrc.cc

@@ -223,6 +223,211 @@ def encode_batch(
     return out
 
 
+def _as_row(x) -> np.ndarray:
+    """1-D uint8 view of a survivor payload: the ONE coercion helper
+    (ec/backend._host_row) shared by the stripe seam and both compute
+    backends — DeviceBuf tokens fetch host-side, bytes-likes go
+    through frombuffer."""
+    from .backend import _host_row
+
+    return _host_row(x)
+
+
+def survivor_basis(
+    matrix: np.ndarray, erasures, k: int, w: int
+) -> tuple[np.ndarray, list[int]]:
+    """The survivor basis B⁻¹ (k × k over GF(2^w)) and the k survivor
+    ids it spans: B⁻¹ @ survivor_chunks = data_chunks.  A thin
+    error-translating wrapper over :func:`gf.survivor_basis` — the
+    SAME implementation the per-op decode's make_decoding_matrix
+    builds on, so the batched and per-op paths can never pick
+    different systems."""
+    from .. import gf
+
+    try:
+        return gf.survivor_basis(matrix, erasures, k, w)
+    except (ValueError, np.linalg.LinAlgError) as e:
+        raise ErasureCodeError(f"{e} (-EIO)")
+
+
+def reconstruction_rows(
+    matrix: np.ndarray, want, available, k: int, w: int
+) -> tuple[np.ndarray, list[int]]:
+    """ONE GF(2^w) matrix that rebuilds every wanted chunk (data or
+    coding) straight from the k chosen survivors — the whole-PG repair
+    collapses to a single matrix × survivor-regions dispatch.  Wanted
+    data chunks take their B⁻¹ row; wanted coding chunks compose the
+    generator row with B⁻¹ (exact field algebra, so the result is
+    byte-identical to decode-data-then-re-encode).  Returns
+    (rows[len(want), k], survivors)."""
+    from .. import gf
+
+    n = k + matrix.shape[0]
+    erasures = sorted(set(range(n)) - set(available))
+    binv, survivors = survivor_basis(matrix, erasures, k, w)
+    rows = []
+    for p in sorted(want):
+        if p < k:
+            rows.append(binv[p])
+        else:
+            rows.append(
+                gf.matrix_multiply(
+                    matrix[p - k : p - k + 1], binv, w
+                )[0]
+            )
+    return np.array(rows, dtype=np.int64).reshape(len(rows), k), survivors
+
+
+def decode_reconstruction(ec, want, available):
+    """The decode analog of :func:`_matrix_fast_path`: a
+    (rows, survivors, w, backend) plan that rebuilds ``want`` from
+    ``available`` in one batched device dispatch, or None when the
+    code family cannot express its repair as whole-word matrix math
+    (bitmatrix/layered codes without a ``decode_matrix`` hook, chunk
+    remapping, unsolvable systems)."""
+    hook = getattr(ec, "decode_matrix", None)
+    if hook is not None:
+        try:
+            return hook(set(want), set(available))
+        except ErasureCodeError:
+            return None
+    matrix, backend, ok = _matrix_fast_path(
+        ec, "decode_stripes_batch"
+    )
+    if not ok:
+        return None
+    try:
+        rows, survivors = reconstruction_rows(
+            matrix, want, available, ec.get_data_chunk_count(), ec.w
+        )
+    except ErasureCodeError:
+        return None
+    return rows, survivors, ec.w, backend
+
+
+def _decode_one(ec, shards: dict[int, np.ndarray], want) -> dict:
+    """Per-object decode-from-survivors — the reference per-op repair
+    path (ErasureCode::_decode) and the oracle the batched dispatch
+    must match byte for byte."""
+    chunks = {i: _as_row(v) for i, v in shards.items()}
+    decoded = ec._decode(set(want), chunks)
+    return {
+        p: np.ascontiguousarray(decoded[p], dtype=np.uint8)
+        for p in sorted(want)
+    }
+
+
+def decode_batch(
+    sinfo: StripeInfo, ec, shard_sets, want
+) -> list[dict]:
+    """Coalesced decode-from-survivors: rebuild the SAME missing
+    positions (``want`` — the dead OSD's shards) for MANY objects in
+    one pipelined device pass, the repair-side twin of
+    :func:`encode_batch` (ROADMAP open item 2).
+
+    ``shard_sets`` is one dict per object of survivor shard payloads
+    ({position: bytes | ndarray | DeviceBuf}); resident DeviceBufs
+    ride the dispatch without re-uploading (the residency cache paid
+    the link already), host payloads upload once, double-buffered
+    against compute.  Returns one {position: reconstructed} dict per
+    object — DeviceBuf tokens (device-born, zero extra transfer to
+    register resident) when the device backend ran, numpy arrays on
+    the host fallback.  Byte-identical to the per-object
+    ``ec._decode`` repair by construction; ANY batched-path failure
+    degrades to it.
+
+    Each coalesced dispatch counts in
+    ``l_tpu_batch_decode_{dispatches,ops_per_dispatch}``.
+    """
+    want = sorted(set(want))
+    out: list[dict | None] = [None] * len(shard_sets)
+    groups: dict[frozenset, list[int]] = {}
+    for i, shards in enumerate(shard_sets):
+        groups.setdefault(frozenset(shards), []).append(i)
+    ks = _kstats()
+    from ..ops.residency import ensure_counters
+
+    ensure_counters(ks)
+    cs = sinfo.chunk_size
+    for key, idxs in groups.items():
+        plan = (
+            decode_reconstruction(ec, want, key)
+            if len(idxs) >= 2 and not (set(want) & key)
+            else None
+        )
+        batched = False
+        if plan is not None:
+            rows, survivors, w, backend = plan
+            try:
+                row_sets = []
+                total = 0
+                for i in idxs:
+                    rows_i = [shard_sets[i][s] for s in survivors]
+                    lengths = {len(r) for r in rows_i}
+                    if len(lengths) != 1:
+                        raise ErasureCodeError(
+                            "survivor shards must be equal length"
+                        )
+                    (length,) = lengths
+                    if length % cs or length == 0:
+                        raise ErasureCodeError(
+                            "shard length not chunk aligned"
+                        )
+                    total += length * len(rows_i)
+                    row_sets.append(rows_i)
+                with ks.timed("ec_decode", bytes_in=total) as kt:
+                    outs = backend.decode_stripes_batch(
+                        rows, row_sets, w, cs
+                    )
+                    kt.bytes_out = sum(
+                        int(np.prod(o.shape)) for o in outs
+                    )
+                ks.perf.inc("l_tpu_batch_decode_dispatches")
+                ks.perf.inc(
+                    "l_tpu_batch_decode_ops_per_dispatch", len(idxs)
+                )
+                for i, rec in zip(idxs, outs):
+                    out[i] = _wrap_decoded(rec, want)
+                batched = True
+            except Exception:  # noqa: BLE001 — batching is an
+                # optimization: any device/shape/solve failure
+                # degrades this group to the per-object repair path,
+                # never drops or corrupts an object
+                batched = False
+        if not batched:
+            for i in idxs:
+                with ks.timed(
+                    "ec_decode",
+                    bytes_in=sum(
+                        len(v) for v in shard_sets[i].values()
+                    ),
+                ) as kt:
+                    out[i] = _decode_one(ec, shard_sets[i], want)
+                    kt.bytes_out = sum(
+                        len(v) for v in out[i].values()
+                    )
+    return out
+
+
+def _wrap_decoded(rec, want) -> dict:
+    """One object's (nstripes, len(want), chunk) reconstruction →
+    {position: payload}.  Device arrays wrap as device-born
+    DeviceBufs (the push/write path fetches host bytes at most once;
+    registering them resident costs zero extra transfer); numpy
+    results stay numpy."""
+    if isinstance(rec, np.ndarray):
+        return {
+            p: np.ascontiguousarray(rec[:, j, :]).reshape(-1)
+            for j, p in enumerate(want)
+        }
+    from ..ops.residency import DeviceBuf
+
+    return {
+        p: DeviceBuf(dev=rec[:, j, :].reshape(-1))
+        for j, p in enumerate(want)
+    }
+
+
 def decode_concat(
     sinfo: StripeInfo, ec, shards: dict[int, np.ndarray]
 ) -> np.ndarray:

@@ -32,6 +32,7 @@ from .matrix import (
     jerasure_bitmatrix,
     make_decoding_matrix,
     matrix_invert,
+    survivor_basis,
     matrix_multiply,
     matrix_vector_mul_region,
     reed_sol_r6_coding_matrix,
@@ -53,6 +54,7 @@ __all__ = [
     "matrix_multiply",
     "matrix_vector_mul_region",
     "make_decoding_matrix",
+    "survivor_basis",
     "reed_sol_vandermonde_coding_matrix",
     "reed_sol_r6_coding_matrix",
     "isa_rs_matrix",

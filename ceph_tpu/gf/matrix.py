@@ -271,6 +271,33 @@ def matrix_invert(mat: np.ndarray, w: int = 8) -> np.ndarray:
     return inv
 
 
+def survivor_basis(
+    coding_matrix: np.ndarray,
+    erasures,
+    k: int,
+    w: int = 8,
+) -> tuple[np.ndarray, list[int]]:
+    """The survivor basis B⁻¹ (k × k over GF(2^w)) and the k survivor
+    ids it spans (first k available, ascending — data-then-coding
+    order): B⁻¹ @ survivor_chunks = data_chunks.  The ONE
+    implementation both the per-op decode (make_decoding_matrix) and
+    the batched reconstruction-matrix path (ec/stripe) build on —
+    their byte identity rests on picking the SAME system."""
+    m = coding_matrix.shape[0]
+    erased = set(erasures)
+    survivors = [i for i in range(k + m) if i not in erased][:k]
+    if len(survivors) < k:
+        raise ValueError("not enough surviving chunks to decode")
+    # B[r] = unit row for surviving data chunk, coding row for surviving parity
+    b = np.zeros((k, k), dtype=np.int64)
+    for r, chunk in enumerate(survivors):
+        if chunk < k:
+            b[r, chunk] = 1
+        else:
+            b[r] = coding_matrix[chunk - k]
+    return matrix_invert(b, w), survivors
+
+
 def make_decoding_matrix(
     coding_matrix: np.ndarray,
     erasures: list[int],
@@ -286,20 +313,8 @@ def make_decoding_matrix(
     and maps the survivor chunk vector to each erased data chunk; survivors
     is the list of k chunk ids used as input, ascending.
     """
-    m = coding_matrix.shape[0]
-    erased = set(erasures)
-    survivors = [i for i in range(k + m) if i not in erased][:k]
-    if len(survivors) < k:
-        raise ValueError("not enough surviving chunks to decode")
-    # B[r] = unit row for surviving data chunk, coding row for surviving parity
-    b = np.zeros((k, k), dtype=np.int64)
-    for r, chunk in enumerate(survivors):
-        if chunk < k:
-            b[r, chunk] = 1
-        else:
-            b[r] = coding_matrix[chunk - k]
-    binv = matrix_invert(b, w)
-    data_erasures = sorted(e for e in erased if e < k)
+    binv, survivors = survivor_basis(coding_matrix, erasures, k, w)
+    data_erasures = sorted(e for e in set(erasures) if e < k)
     rows = np.array([binv[e] for e in data_erasures], dtype=np.int64).reshape(
         len(data_erasures), k
     )

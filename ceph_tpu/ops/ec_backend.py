@@ -37,6 +37,7 @@ def _on_tpu() -> bool:
 
 import functools
 
+from ..ec.backend import _host_row as _row_u8
 
 @functools.lru_cache(maxsize=512)
 def _host_bitmatrix(key: bytes, shape: tuple, w: int):
@@ -231,6 +232,134 @@ class JaxBackend:
                 off = 0
             outs.append(mats[gi][off : off + nb])
             off += nb
+        return outs
+
+    def decode_stripes_batch(
+        self,
+        matrix: np.ndarray,
+        row_sets,
+        w: int,
+        chunk: int,
+        group_stripes: int = 256,
+    ) -> list:
+        """Coalesced decode-from-survivors: the repair-side twin of
+        :meth:`matrix_stripes_batch`.  ``row_sets`` is one list per
+        object of equal-length 1-D survivor shard payloads — numpy
+        arrays or resident DeviceBuf tokens.  Resident survivors ride
+        the dispatch with ZERO re-upload (their link cost was paid at
+        registration); host-only objects pack into
+        ~``group_stripes``-stripe groups whose uploads double-buffer
+        against compute, exactly like the write path.  The ONLY sync
+        is the final block_until_ready, and the outputs stay DEVICE
+        arrays — reconstructed shards leave device-born (the caller
+        wraps them in DeviceBufs; host bytes are fetched at most once
+        by whoever pushes/writes them)."""
+        import jax
+
+        from .residency import is_device_buf
+
+        total = sum(len(r) for rows in row_sets for r in rows)
+        with kernel_stats().timed("gf_matmul", bytes_in=total) as kt:
+            bm = matrix_to_device_bitmatrix(matrix, w)
+            outs: list = [None] * len(row_sets)
+            host_idx: list[int] = []
+            pending: dict[int, tuple] = {}
+            for i, rows in enumerate(row_sets):
+                if any(is_device_buf(r) for r in rows):
+                    # ONE device_put for the object's host rows (a
+                    # single resident survivor must not force the
+                    # rest row-by-row — the PR 10 _gather_rows
+                    # lesson), then a device-side stack interleaves
+                    # them with the already-resident rows
+                    host_js = [
+                        j
+                        for j, r in enumerate(rows)
+                        if not is_device_buf(r)
+                    ]
+                    blk = (
+                        jax.device_put(
+                            np.stack(
+                                [
+                                    _row_u8(rows[j]).reshape(
+                                        -1, chunk
+                                    )
+                                    for j in host_js
+                                ]
+                            )
+                        )
+                        if host_js
+                        else None
+                    )
+                    hi = 0
+                    devs = []
+                    for j, r in enumerate(rows):
+                        if is_device_buf(r):
+                            devs.append(
+                                r.device().reshape(-1, chunk)
+                            )
+                        else:
+                            devs.append(blk[hi])
+                            hi += 1
+                    dev = jnp.stack(devs, axis=1)
+                    pending[i] = (
+                        self._bitplane_dispatch(bm, dev, w),
+                        dev.shape[0],
+                    )
+                else:
+                    host_idx.append(i)
+            arrays = {
+                i: np.stack(
+                    [
+                        _row_u8(r).reshape(-1, chunk)
+                        for r in row_sets[i]
+                    ],
+                    axis=1,
+                )
+                for i in host_idx
+            }
+            groups: list[list[int]] = []
+            cur: list[int] = []
+            cur_b = 0
+            for i in host_idx:
+                b = arrays[i].shape[0]
+                if cur and cur_b + b > group_stripes:
+                    groups.append(cur)
+                    cur, cur_b = [], 0
+                cur.append(i)
+                cur_b += b
+            if cur:
+                groups.append(cur)
+
+            def upload(group):
+                arr = (
+                    np.concatenate([arrays[i] for i in group])
+                    if len(group) > 1
+                    else arrays[group[0]]
+                )
+                # async transfer: overlaps the already-dispatched
+                # decode of the previous group — the double buffer
+                return jax.device_put(arr)
+
+            gouts = []
+            if groups:
+                dev = upload(groups[0])
+                for j in range(len(groups)):
+                    gouts.append(self._bitplane_dispatch(bm, dev, w))
+                    if j + 1 < len(groups):
+                        dev = upload(groups[j + 1])
+            for j, group in enumerate(groups):
+                mat = gouts[j]
+                off = 0
+                for i in group:
+                    b = arrays[i].shape[0]
+                    outs[i] = mat[off : off + b]
+                    off += b
+            for i, (mat, b) in pending.items():
+                outs[i] = mat[:b]
+            # sync ONLY here (the commit point); results STAY on
+            # device for device-born registration downstream
+            outs = [jax.block_until_ready(o) for o in outs]
+            kt.bytes_out = sum(int(np.prod(o.shape)) for o in outs)
         return outs
 
     @staticmethod

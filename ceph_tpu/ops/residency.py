@@ -156,7 +156,9 @@ class ResidencyCache:
         return (residency_gens.store_token(store), cid, oid)
 
     # -- writes ------------------------------------------------------------
-    def put_committed(self, store, cid: str, oid: str, data=None):
+    def put_committed(
+        self, store, cid: str, oid: str, data=None, dev=None
+    ):
         """Register bytes a transaction THIS THREAD just committed.
 
         The generation captured is the one that txn itself assigned
@@ -170,7 +172,7 @@ class ResidencyCache:
         gen = residency_gens.txn_gen(store, cid, oid)
         if gen is None:
             return None
-        return self.put(store, cid, oid, data=data, gen=gen)
+        return self.put(store, cid, oid, data=data, dev=dev, gen=gen)
 
     def put(
         self, store, cid: str, oid: str, data=None, dev=None, gen=None
@@ -310,6 +312,18 @@ def ensure_counters(ks) -> None:
         "batch_encode", "ops_per_dispatch",
         desc="client writes folded into coalesced passes "
         "(cumulative; divide by dispatches for the mean writes "
+        "folded per pass)",
+    )
+    ks.counter(
+        "batch_decode", "dispatches",
+        desc="coalesced decode-from-survivors passes (one "
+        "decode_batch group each; the backend may pipeline a pass "
+        "as several device groups)",
+    )
+    ks.counter(
+        "batch_decode", "ops_per_dispatch",
+        desc="objects rebuilt through coalesced decode passes "
+        "(cumulative; divide by dispatches for the mean objects "
         "folded per pass)",
     )
 

@@ -85,7 +85,7 @@ from ..msg import (
     MScrubCommand,
     MScrubMap,
 )
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dc_field
 
 from ..common import tracing
 from ..common.histogram import LogHistogram, PerfHistogram2D
@@ -176,6 +176,12 @@ def _log_oid(version: tuple[int, int]) -> str:
     return f"{LOG_PREFIX}{version[0]:010d}.{version[1]:020d}"
 
 
+def _interval_json(interval: tuple) -> list:
+    """The (acting, primary) interval in its JSON round-trip shape
+    (the watermark comparison must survive tuple→list decoding)."""
+    return [list(interval[0]), interval[1]]
+
+
 def _encode_entry(entry: LogEntry) -> bytes:
     e = Encoder()
     entry.encode(e)
@@ -215,10 +221,22 @@ class PG:
         # the (acting, primary) interval last peered, so unrelated
         # epoch bumps don't trigger a re-peering RPC storm
         self.peered_interval: tuple | None = None
+        # the interval last OBSERVED by the map walk (set whether or
+        # not peering succeeded): interval-death detection compares
+        # against this — comparing against peered_interval would
+        # read every unpeered pass as a "change" and abort the very
+        # RecoveryOp the previous pass just started
+        self.current_interval: tuple | None = None
         # recently applied client reqids → (version, outdata) (the
         # pg log dups role): outlives trimmed entries so a late retry
         # still dedups AND replays its original result
         self.reqid_cache: dict[str, tuple] = {}
+        # objects THIS osd (as primary) adopted log entries for but
+        # could not pull yet (the primary's own missing set,
+        # PeeringState::needs_recovery role): the stale local copy is
+        # dropped on the failed pull, and the peering pass retries
+        # until the hole closes — the interval stays unpeered
+        self.self_missing: dict[str, tuple] = {}
         # erasure pools: cached (key, ECStore, conns) view over the
         # acting set; rebuilt when the interval/up-set/conns change
         self.ec_view: tuple | None = None
@@ -243,7 +261,15 @@ class _RecoveryOp:
     """One peer's in-flight async recovery (RecoveryOp,
     src/osd/ECBackend.h:249 reduced): push items drain through the
     scheduler; the last one activates the peer and releases both
-    reservations."""
+    reservations.
+
+    ``interval`` pins the (acting, primary) this op was planned
+    against — the generation check every push re-validates, so an
+    interval death mid-recovery aborts the remaining pushes instead
+    of landing stale shards on a peer whose position moved.
+    ``versions`` records the exact version each push carries and
+    ``pushed`` the completed ones — the persisted backfill watermark,
+    so an interrupted recovery resumes without re-pushing."""
 
     pg: "PG"
     epoch: int
@@ -251,6 +277,9 @@ class _RecoveryOp:
     since: tuple
     conn: Connection
     remaining: set
+    interval: tuple = ()
+    versions: dict = dc_field(default_factory=dict)
+    pushed: dict = dc_field(default_factory=dict)
     failed: bool = False
 
 
@@ -266,6 +295,31 @@ def build_osd_perf(whoami: int):
         .add_time_avg("op_latency", "client op latency")
         .add_u64_gauge("numpg", "hosted pgs")
         .add_u64_gauge("recovery_active", "in-flight recovery pushes")
+        # recovery-storm plane (the l_osd_recovery_* block,
+        # ROADMAP open item 2): push/byte totals, coalesced
+        # decode-from-survivors batches, and the survivor-read
+        # fan-in the LRC locality claim is measured from
+        .add_u64_counter("recovery_pushes", "recovery pushes completed")
+        .add_u64_counter(
+            "recovery_push_bytes", "object bytes pushed by recovery"
+        )
+        .add_u64_counter(
+            "recovery_batches",
+            "coalesced decode-from-survivors rebuild dispatches",
+        )
+        .add_u64_counter(
+            "recovery_batch_ops",
+            "recovery pushes served from coalesced rebuilds",
+        )
+        .add_u64_counter(
+            "recovery_survivor_shards",
+            "helper shards consulted to rebuild pushed objects "
+            "(the recovery-read fan-in)",
+        )
+        .add_u64_counter(
+            "recovery_helper_bytes",
+            "helper shard bytes read to rebuild pushed objects",
+        )
         .add_u64_counter("tier_flush", "cache-tier agent flushes")
         .add_u64_counter("tier_evict", "cache-tier agent evictions")
         .add_u64_gauge(
@@ -395,6 +449,13 @@ class OSD(Dispatcher):
         self.osd_tpu_batch_max = int(
             self.config.get("osd_tpu_batch_max")
         )
+        # recovery coalescing (ROADMAP item 2): the worker drains up
+        # to this many queued same-peer recovery pushes per dispatch
+        # and rebuilds them as ONE batched decode-from-survivors
+        # device call (1 disables)
+        self.osd_recovery_batch_max = int(
+            self.config.get("osd_recovery_batch_max")
+        )
         # distributed tracing (common/tracing.py): per-stage spans
         # under the client reqid, drained onto the MMgrReport push
         self.tracer = tracing.Tracer(
@@ -513,6 +574,9 @@ class OSD(Dispatcher):
         # repop sub-op timeout (tests shrink it so chaos partitions
         # fail fast instead of wedging the worker for 10s per write)
         self.repop_timeout = 10.0
+        # recovery push call timeout (same role: a chaos-dropped push
+        # must fail the RecoveryOp fast, not wedge the worker)
+        self.recovery_push_timeout = 10.0
         # RADOS backoff protocol state (the Backoff registry of
         # src/osd/osd_types.h, session-scoped in the reference;
         # keyed by id here): id -> {pgid, reason, conn, since}
@@ -661,13 +725,29 @@ class OSD(Dispatcher):
                     pg = self.pgs.get(pgid)
                     if pg is not None:
                         pg.state = "stray"
+                        # no longer a member at all: any in-flight
+                        # recovery this (ex-)primary was driving is
+                        # for a dead interval
+                        self._abort_pg_recovery(pgid)
                     continue
                 pg = self._get_or_create_pg(pgid)
                 interval = (tuple(acting), primary)
                 with self._pg_lock:
                     changed = pg.peered_interval != interval
+                    interval_died = (
+                        pg.current_interval is not None
+                        and pg.current_interval != interval
+                    )
+                    pg.current_interval = interval
                     pg.acting = acting
                     pg.primary = primary
+                if interval_died:
+                    # interval death (a REAL transition, not just an
+                    # unpeered re-walk): in-flight RecoveryOps were
+                    # planned against the old acting set — abort them
+                    # (queued pushes drain without landing stale
+                    # shards; reservations release on the drain)
+                    self._abort_pg_recovery(pgid)
                 if primary == self.whoami:
                     # re-peer only on interval change (the reference's
                     # new-interval test) — an unrelated epoch bump must
@@ -784,7 +864,15 @@ class OSD(Dispatcher):
                     stores.append(UnreachableStore())
                     continue
                 conns.append(conn)
-                stores.append(RemoteStore(conn, timeout=15.0))
+                # sub-op reads share the repop SLA: a freshly-dead
+                # peer's session conn BLOCKS (it queues for replay
+                # rather than refusing), so the timeout bounds how
+                # long one dead shard can wedge the worker
+                stores.append(
+                    RemoteStore(
+                        conn, timeout=max(self.repop_timeout, 5.0)
+                    )
+                )
         ecs = ECStore(
             ec=codec.ec,
             stores=stores,
@@ -812,8 +900,13 @@ class OSD(Dispatcher):
         reachable: list[int] = []
         for osd in peers:
             try:
+                # bounded like every sub-op: a chaos-dropped query
+                # (or a freshly-dead peer's queue-for-replay session
+                # conn) must not wedge the worker for the default
+                # call timeout per peer per pass
                 reply = self._peer_conn(osd).call(
-                    MPGQuery(pgid=pg.pgid, epoch=epoch)
+                    MPGQuery(pgid=pg.pgid, epoch=epoch),
+                    timeout=self.repop_timeout,
                 )
             except (MessageError, OSError):
                 continue
@@ -830,10 +923,14 @@ class OSD(Dispatcher):
         best = find_best_info(infos)
         if best is not None and best != self.whoami:
             self._get_log(pg, epoch, best, infos[best])
+        # close our OWN holes (failed pulls from this or an earlier
+        # pass — e.g. a half-recovered OSD promoted to primary by a
+        # failover) before recovering peers: a primary serving reads
+        # must not sit on adopted-but-unpulled objects
+        all_ok = self._recover_self_missing(pg, epoch, reachable)
 
         # primary consistent: rewind+push what each reachable peer
         # misses, then activate everyone
-        all_ok = True
         for osd in reachable:
             peer_info = infos.get(osd, PGInfo(pgid=pg.pgid))
             rewind = self._divergence_point(
@@ -876,7 +973,8 @@ class OSD(Dispatcher):
             since = best_info.log_tail
         try:
             reply = self._peer_conn(best).call(
-                MPGLogReq(pgid=pg.pgid, epoch=epoch, since=since)
+                MPGLogReq(pgid=pg.pgid, epoch=epoch, since=since),
+                timeout=self.repop_timeout,
             )
         except (MessageError, OSError):
             return
@@ -891,12 +989,71 @@ class OSD(Dispatcher):
             self._persist_entry(pg, entry)
             missing[entry.oid] = entry
         for oid, entry in missing.items():
-            self._pull_object(pg, epoch, best, oid, entry)
+            if self._pull_object(pg, epoch, best, oid, entry):
+                pg.self_missing.pop(oid, None)
+            else:
+                # a failed pull must not become a SILENT hole while
+                # the log/info advance past it: record it so the
+                # peering pass retries until the object lands (the
+                # stale divergent copy was already dropped)
+                pg.self_missing[oid] = entry.version
         pg.info.last_update = pg.log.head
         pg.seq = max(pg.seq, pg.info.last_update[1])
+        # adopting an authoritative log must not leave this pg over
+        # its bound (the donor may keep a longer log than ours)
+        self._maybe_trim(pg)
         self._persist_info(pg)
 
-    def _pull_object(self, pg, epoch, source, oid, entry) -> None:
+    def _recover_self_missing(
+        self, pg: PG, epoch: int, peers: list[int]
+    ) -> bool:
+        """Close the primary's OWN holes (objects whose authoritative
+        log entries were adopted but whose pull failed — e.g. the
+        serving peer's store view still pointed at a freshly-dead
+        OSD): retry from ANY reachable peer.  Returns True when no
+        hole remains; False keeps the interval unpeered so the tick
+        retries."""
+        for oid in list(pg.self_missing):
+            entry = pg.log.object_op(oid)
+            if (
+                entry is not None
+                and entry.version != pg.self_missing[oid]
+            ):
+                # superseded by a newer write this primary itself
+                # applied: no longer our hole to pull
+                pg.self_missing.pop(oid, None)
+                continue
+            if entry is None:
+                # the entry TRIMMED out of the log — but the object
+                # is still missing locally; dropping the hole here
+                # would permanently serve -ENOENT for bytes every
+                # replica still holds.  Pull by the recorded version
+                # (the entry only gates the DELETE shortcut).
+                entry = LogEntry(
+                    op=MODIFY, oid=oid,
+                    version=pg.self_missing[oid],
+                )
+            pulled = False
+            for osd in peers:
+                if self._pull_object(pg, epoch, osd, oid, entry):
+                    pg.self_missing.pop(oid, None)
+                    pulled = True
+                    break
+            if not pulled:
+                # NO peer could serve this object right now: later
+                # ones will almost surely fail the same way, and
+                # each failed pull holds the worker for a timeout —
+                # stop the sweep; the tick re-peers and retries
+                return False
+        return not pg.self_missing
+
+    def _pull_object(self, pg, epoch, source, oid, entry) -> bool:
+        """Pull one object this OSD's log says it misses; returns
+        True when the object's authoritative state landed locally.
+        On a FAILED pull the stale local copy is dropped — the
+        authoritative log says the object changed past our head, so
+        serving the old bytes would be a read-after-ack violation —
+        and the object becomes honestly missing for the retry."""
         if entry.op == DELETE:
             try:
                 self.store.queue_transaction(
@@ -904,22 +1061,35 @@ class OSD(Dispatcher):
                 )
             except StoreError:
                 pass
-            return
+            return True
         shard = -1
         if self._is_ec(pg):
             if self.whoami not in pg.acting:
-                return  # stray: nothing to hold here
+                return True  # stray: nothing to hold here
             shard = pg.acting.index(self.whoami)
         try:
             reply = self._peer_conn(source).call(
                 MPGPull(
                     pgid=pg.pgid, epoch=epoch, oid=oid, shard=shard
-                )
+                ),
+                timeout=self.repop_timeout,
             )
         except (MessageError, OSError):
-            return
+            try:
+                self.store.queue_transaction(
+                    Transaction().remove(pg.cid, OBJ_PREFIX + oid)
+                )
+            except StoreError:
+                pass
+            return False
         if isinstance(reply, MPGPush):
+            # exists=False is an AUTHORITATIVE answer ("the object is
+            # gone everywhere", e.g. a logged CALL removal) — apply
+            # it as the removal it is; treating it as a failed pull
+            # would loop the oid in self_missing forever
             self._apply_push(pg, reply)
+            return True
+        return False
 
     def _apply_push(self, pg: PG, push: MPGPush) -> None:
         txn = Transaction()
@@ -959,6 +1129,37 @@ class OSD(Dispatcher):
         except (MessageError, OSError):
             return False
 
+        interval = (tuple(pg.acting), pg.primary)
+        prior_pushed: dict[str, tuple] = {}
+        if not missing:
+            # recovery confirmed complete for this interval: any
+            # watermark left behind by an interrupted run is done
+            self._clear_watermark(pg, osd)
+        else:
+            # persisted backfill watermark: pushes a PRIOR interrupted
+            # run of this same (interval, since) completed carry their
+            # exact version — skip re-pushing an object whose current
+            # version already landed (a newer write re-pushes)
+            wm = self._load_watermark(pg, osd)
+            if wm is not None:
+                if (
+                    wm.get("interval") == _interval_json(interval)
+                    and tuple(wm.get("since", ())) == tuple(since)
+                ):
+                    prior_pushed = {
+                        oid: tuple(v)
+                        for oid, v in wm.get("pushed", {}).items()
+                    }
+                    missing = {
+                        oid: v
+                        for oid, v in missing.items()
+                        if prior_pushed.get(oid) != tuple(v)
+                    }
+                else:
+                    # interval (or rewind point) died with the run
+                    # that wrote it: the watermark is meaningless now
+                    self._clear_watermark(pg, osd)
+
         if missing:
             key = (pg.pgid, osd)
             with self._recovery_lock:
@@ -996,6 +1197,8 @@ class OSD(Dispatcher):
             state = _RecoveryOp(
                 pg=pg, epoch=epoch, osd=osd, since=since,
                 conn=conn, remaining=set(missing),
+                interval=interval, versions=dict(missing),
+                pushed=dict(prior_pushed),
             )
             with self._recovery_lock:
                 self._recovering[key] = state
@@ -1035,9 +1238,91 @@ class OSD(Dispatcher):
         except (MessageError, OSError):
             pass
 
-    def _do_recover_push(self, key: tuple[str, int], oid: str) -> None:
+    def _recovery_interval_ok(self, state: "_RecoveryOp") -> bool:
+        """The generation check every push re-validates: the interval
+        this RecoveryOp was planned against must still be current
+        (same acting set, same primary, and that primary is us) —
+        otherwise a push would land a shard computed for a position
+        assignment that no longer exists (a stale shard the next
+        peering would silently trust)."""
+        pg = state.pg
+        return (
+            pg.primary == self.whoami
+            and (tuple(pg.acting), pg.primary) == state.interval
+        )
+
+    def _abort_pg_recovery(self, pgid: str) -> None:
+        """Interval death: fail every in-flight RecoveryOp for this
+        PG so the queued pushes drain WITHOUT touching peers and
+        _finish_recovery releases both reservations promptly."""
+        with self._recovery_lock:
+            for (pid, _osd), state in self._recovering.items():
+                if pid == pgid:
+                    state.failed = True
+
+    def _coalesce_recovery_items(self, item) -> list:
+        """After dequeuing a recovery push, drain up to
+        ``osd_recovery_batch_max - 1`` more CONSECUTIVE pushes for
+        the SAME (pg, peer) RecoveryOp: they ride one coalesced
+        decode-from-survivors dispatch while every push still sends,
+        completes, and watermarks individually, in queue order —
+        the repair-side twin of _coalesce_op_items."""
+        if self.osd_recovery_batch_max <= 1:
+            return []
+        key = item[1]
+
+        def matches(it) -> bool:
+            # cheap + lock-free: runs under the scheduler lock
+            return (
+                isinstance(it, tuple)
+                and len(it) == 3
+                and it[0] == "recover_push"
+                and it[1] == key
+            )
+
+        return self._workq.drain_class(
+            CLASS_RECOVERY, matches, self.osd_recovery_batch_max - 1
+        )
+
+    def _do_recover_push_batch(self, items: list) -> None:
+        """Serve a coalesced recovery batch: ONE batched
+        decode-from-survivors dispatch rebuilds every drained
+        object's shard (ECStore.reconstruct_shards_batch through the
+        per-PG store view — survivor shards upload once, outputs
+        device-born), then each push runs its normal per-op path with
+        its MPGPush precomputed — send/reply/watermark/completion
+        semantics unchanged, and a batch failure degrades every push
+        to its own per-op rebuild."""
+        key = items[0][1]
+        with self._recovery_lock:
+            state = self._recovering.get(key)
+        pre: dict[str, MPGPush] = {}
+        if (
+            state is not None
+            and not state.failed
+            and self._recovery_interval_ok(state)
+            and self._is_ec(state.pg)
+            and len(items) > 1
+        ):
+            try:
+                pos = state.pg.acting.index(state.osd)
+                pre = self._ec_push_batch(
+                    state.pg, state.epoch,
+                    [it[2] for it in items], pos,
+                )
+            except Exception:  # noqa: BLE001 — coalescing is an
+                # optimization: a batch failure degrades every push
+                # to the per-op rebuild, never drops one
+                pre = {}
+        for it in items:
+            self._do_recover_push(key, it[2], pre_push=pre.get(it[2]))
+
+    def _do_recover_push(
+        self, key: tuple[str, int], oid: str, pre_push=None
+    ) -> None:
         """One scheduler-drained recovery push; the LAST one (or a
-        failure) completes the RecoveryOp."""
+        failure) completes the RecoveryOp.  ``pre_push`` carries the
+        MPGPush a coalesced batch dispatch already rebuilt."""
         with self._recovery_lock:
             state = self._recovering.get(key)
         if state is None:
@@ -1049,17 +1334,49 @@ class OSD(Dispatcher):
                 self.recovery_active_peak, self._recovery_active
             )
         try:
+            if not state.failed and not self._recovery_interval_ok(
+                state
+            ):
+                # the interval died under this op (second failure,
+                # remap, primary change): abort — a push computed for
+                # the dead interval must never land
+                state.failed = True
             if not state.failed:
                 # once one push failed the rest of the queue DRAINS
                 # without touching the peer: each blocking call
                 # would otherwise hold the worker for a full timeout
                 # per remaining item
-                if self._is_ec(pg):
+                if pre_push is not None:
+                    push = pre_push
+                elif self._is_ec(pg):
                     pos = pg.acting.index(osd)
                     push = self._ec_push_for(pg, epoch, oid, pos)
                 else:
                     push = self._push_for(pg, epoch, oid)
-                state.conn.call(push, timeout=10.0)
+                state.conn.call(
+                    push, timeout=self.recovery_push_timeout
+                )
+                self.perf.inc("recovery_pushes")
+                self.perf.inc("recovery_push_bytes", len(push.data))
+                version = state.versions.get(oid)
+                if version is not None:
+                    with self._recovery_lock:
+                        state.pushed[oid] = tuple(version)
+                        # amortized: the blob rewrites the whole
+                        # pushed map, so persisting EVERY push would
+                        # be O(n^2) bytes over a big storm — and the
+                        # watermark is an optimization (a subset is
+                        # still a valid resume point).  Small ops
+                        # persist per push (the blob is tiny and the
+                        # resume granularity matters most there);
+                        # big ones stride
+                        persist = (
+                            len(state.versions) <= 32
+                            or len(state.pushed) % 8 == 0
+                            or len(state.remaining) <= 1
+                        )
+                    if persist:
+                        self._persist_watermark(pg, osd, state)
         except Exception:  # noqa: BLE001 — ANY failure (unreachable
             # peer, missing shards, an epoch change yanking the osd
             # from pg.acting) must fail the op: completing anyway
@@ -1095,6 +1412,65 @@ class OSD(Dispatcher):
                 )
             except (MessageError, OSError):
                 pass
+
+    # -- backfill watermark (persisted recovery progress) ------------------
+    @staticmethod
+    def _wm_key(osd: int) -> str:
+        return f"rwm_{osd}"
+
+    def _load_watermark(self, pg: PG, osd: int) -> dict | None:
+        """The persisted per-(pg, peer) push progress: {interval,
+        since, pushed: {oid: version}} — valid only while both the
+        interval and the rewind point it was computed for hold."""
+        try:
+            raw = self.store.omap_get(pg.cid, PG_META).get(
+                self._wm_key(osd)
+            )
+        except StoreError:
+            return None
+        if not raw:
+            return None
+        try:
+            wm = json.loads(raw)
+        except ValueError:
+            return None
+        return wm if isinstance(wm, dict) else None
+
+    def _persist_watermark(
+        self, pg: PG, osd: int, state: "_RecoveryOp"
+    ) -> None:
+        """One omap row per completed push: a restarted or
+        re-peered primary resumes instead of re-pushing objects the
+        interrupted run already landed (version-exact, so a client
+        write after the push re-pushes)."""
+        blob = json.dumps(
+            {
+                "interval": _interval_json(state.interval),
+                "since": list(state.since),
+                "pushed": {
+                    o: list(v) for o, v in state.pushed.items()
+                },
+            }
+        ).encode()
+        try:
+            txn = Transaction()
+            txn.touch(pg.cid, PG_META)
+            txn.omap_setkeys(
+                pg.cid, PG_META, {self._wm_key(osd): blob}
+            )
+            self.store.queue_transaction(txn)
+        except StoreError:
+            pass
+
+    def _clear_watermark(self, pg: PG, osd: int) -> None:
+        try:
+            self.store.queue_transaction(
+                Transaction().omap_rmkeys(
+                    pg.cid, PG_META, [self._wm_key(osd)]
+                )
+            )
+        except StoreError:
+            pass
 
     def _push_for(self, pg: PG, epoch: int, oid: str) -> MPGPush:
         """One object's recovery push, attrs + omap included
@@ -1144,7 +1520,7 @@ class OSD(Dispatcher):
             pass
         ecs = self._ec_store_for(pg)
         try:
-            data, _reads, meta = ecs.reconstruct_shard(
+            data, reads, meta = ecs.reconstruct_shard(
                 store_oid, pos, meta
             )
         except ErasureCodeError:
@@ -1152,6 +1528,18 @@ class OSD(Dispatcher):
                 # object gone everywhere (e.g. a logged CALL removal)
                 return push
             raise
+        self.perf.inc("recovery_helper_bytes", reads)
+        return self._ec_push_assemble(pg, push, data, meta, ecs, pos)
+
+    def _ec_push_assemble(
+        self, pg: PG, push: MPGPush, data: bytes, meta: dict,
+        ecs: ECStore, pos: int,
+    ) -> MPGPush:
+        """Attach the rebuilt shard + its HashInfo + the replicated
+        user/class attrs and omap to a push — the ONE assembly both
+        the per-op and the coalesced rebuild paths share (byte
+        identity between them rests on there being a single copy)."""
+        store_oid = OBJ_PREFIX + push.oid
         attrs = {HINFO_KEY: json.dumps(meta).encode()}
         # user/class attrs and omap replicate on every shard — take
         # them from our copy, or any reachable shard when ours is gone
@@ -1183,6 +1571,73 @@ class OSD(Dispatcher):
         push.attrs = attrs
         push.omap = src_omap
         return push
+
+    def _ec_push_batch(
+        self, pg: PG, epoch: int, oids: list, pos: int
+    ) -> dict[str, MPGPush]:
+        """Rebuild position ``pos``'s shard for MANY objects in ONE
+        coalesced decode-from-survivors dispatch
+        (ECStore.reconstruct_shards_batch over the per-PG store view:
+        survivor reads honor minimum_to_decode — LRC repairs touch
+        k_local helpers — local survivors ride the residency cache,
+        reconstructed shards come back device-born) and assemble each
+        object's MPGPush exactly like the per-op path.  Objects the
+        batch cannot serve are simply absent from the result — the
+        caller's per-op path rebuilds them."""
+        out: dict[str, MPGPush] = {}
+        base: dict[str, MPGPush] = {}
+        alive: list[str] = []
+        metas: dict[str, dict] = {}
+        for oid in oids:
+            entry = pg.log.object_op(oid)
+            push = MPGPush(
+                pgid=pg.pgid, epoch=epoch, oid=oid, exists=False,
+                entry_blob=_encode_entry(entry) if entry else b"",
+            )
+            if entry is not None and entry.op == DELETE:
+                out[oid] = push
+                continue
+            base[oid] = push
+            store_oid = OBJ_PREFIX + oid
+            try:
+                # pin the authoritative HashInfo from our own shard
+                # when we hold it (a rewinding peer may expose stale
+                # hinfo), like the per-op path
+                metas[store_oid] = json.loads(
+                    self.store.getattr(pg.cid, store_oid, HINFO_KEY)
+                )
+            except StoreError:
+                pass
+            alive.append(oid)
+        if not alive:
+            return out
+        ecs = self._ec_store_for(pg)
+        results, _fallback, stats = ecs.reconstruct_shards_batch(
+            [OBJ_PREFIX + oid for oid in alive], pos, metas
+        )
+        self.perf.inc(
+            "recovery_survivor_shards", stats["survivor_shards"]
+        )
+        self.perf.inc("recovery_helper_bytes", stats["read_bytes"])
+        served = 0
+        for oid in alive:
+            got = results.get(OBJ_PREFIX + oid)
+            if got is None:
+                continue  # per-op fallback rebuilds (and verifies) it
+            payload, meta = got
+            data = (
+                payload.host()
+                if hasattr(payload, "host")
+                else bytes(payload)
+            )
+            out[oid] = self._ec_push_assemble(
+                pg, base[oid], data, meta, ecs, pos
+            )
+            served += 1
+        if served > 1:
+            self.perf.inc("recovery_batches")
+            self.perf.inc("recovery_batch_ops", served)
+        return out
 
     # -- persistence -------------------------------------------------------
     def _persist_entry(self, pg: PG, entry: LogEntry, txn=None) -> None:
@@ -2467,6 +2922,28 @@ class OSD(Dispatcher):
         (PGLog::rewind_divergent_log + merge_log).  Runs on the worker
         because the re-pulls are nested RPC."""
         pg = self._get_or_create_pg(msg.pgid)
+        if msg.epoch < pg.activated_epoch or (
+            pg.primary == self.whoami
+            and pg.state == "active"
+            and msg.epoch <= self.monc.epoch
+        ):
+            # stale activation (generation check): an older epoch is
+            # a dead interval's late send, and an ACTING PRIMARY
+            # never applies one from an epoch it has already seen —
+            # the failover storm exposed a dead primary's queued
+            # activation rewinding the NEW primary's freshly adopted
+            # log (same epoch, so the epoch test alone cannot catch
+            # it).  An activation from a FUTURE epoch still applies:
+            # it means our own primacy knowledge is the stale side
+            # (a newer interval's primary is activating us before
+            # our map walk caught up).  Ack and drop.
+            try:
+                conn.send(
+                    MPGPushReply(tid=msg.tid, from_osd=self.whoami)
+                )
+            except (MessageError, OSError):
+                pass
+            return
         divergent = pg.log.truncate_after(msg.rewind_to)
         repull: set[str] = set()
         for entry in divergent:  # newest first
@@ -2500,14 +2977,21 @@ class OSD(Dispatcher):
                 repull = set()  # stray shard: next peering re-places it
         for oid in sorted(repull):
             try:
+                # bounded: an activating primary that died right
+                # after sending must not wedge this worker for the
+                # full default call timeout PER OBJECT
                 reply = conn.call(
                     MPGPull(
                         pgid=pg.pgid, epoch=msg.epoch, oid=oid,
                         shard=shard,
-                    )
+                    ),
+                    timeout=self.repop_timeout,
                 )
             except (MessageError, OSError):
-                continue
+                # the primary is gone: every further pull on this
+                # conn eats another timeout — stop; the objects stay
+                # missing and the NEXT interval's primary pushes them
+                break
             if isinstance(reply, MPGPush):
                 self._apply_push(pg, reply)
         for blob in msg.entry_blobs:
@@ -2527,6 +3011,9 @@ class OSD(Dispatcher):
         pg.seq = max(pg.seq, pg.info.last_update[1])
         pg.state = "replica"
         pg.activated_epoch = msg.epoch
+        # the adopted suffix counts against the log bound like any
+        # other appends (rep-ops trim; activation must too)
+        self._maybe_trim(pg)
         self._persist_info(pg)
         conn.send(MPGPushReply(tid=msg.tid, from_osd=self.whoami))
 
@@ -3136,7 +3623,11 @@ class OSD(Dispatcher):
                 elif kind == "pull":
                     self._handle_pull(item[1], item[2])
                 elif kind == "recover_push":
-                    self._do_recover_push(item[1], item[2])
+                    extra = self._coalesce_recovery_items(item)
+                    if extra:
+                        self._do_recover_push_batch([item] + extra)
+                    else:
+                        self._do_recover_push(item[1], item[2])
                 elif kind == "split":
                     pg = self.pgs.get(item[1])
                     if (
@@ -3778,6 +4269,14 @@ class OSD(Dispatcher):
 
     def _tick(self) -> None:
         now = time.monotonic()
+        # expired remote recovery leases purge on the TICK, not just
+        # on the next reservation request: a primary that died
+        # without releasing would otherwise pin its slot (and look
+        # like a leak) until some future primary happens to ask
+        with self._recovery_lock:
+            for k, (t0, _c) in list(self._remote_reservations.items()):
+                if now - t0 > self.reservation_timeout:
+                    del self._remote_reservations[k]
         # retry peering for primary PGs whose recovery pushes
         # failed (peered_interval cleared) — at tick rate, never
         # as a hot worker loop

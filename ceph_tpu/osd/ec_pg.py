@@ -125,6 +125,20 @@ class ECCodec:
             )
         return out
 
+    def decode_object_batch(self, shard_sets, want) -> list[dict]:
+        """Batched decode-from-survivors (the repair-side twin of
+        :meth:`encode_object_batch`, ROADMAP open item 2): rebuild
+        the SAME missing positions for many objects in one coalesced
+        device dispatch.  ``shard_sets`` holds one survivor dict per
+        object ({position: bytes | DeviceBuf}); returns one
+        {position: payload} per object, device-born DeviceBufs when
+        the device path ran.  Byte-identical to per-object decode and
+        degrades to it on any batched-path failure
+        (ec/stripe.decode_batch)."""
+        from ..ec.stripe import decode_batch
+
+        return decode_batch(self.sinfo, self.ec, shard_sets, want)
+
 
 def rmw_write_txns(
     codec: ECCodec,

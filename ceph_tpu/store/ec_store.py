@@ -325,10 +325,18 @@ class ECStore:
         return out
 
     def _write_shard(
-        self, store: ObjectStore, name: str, shard: bytes, meta: dict
+        self,
+        store: ObjectStore,
+        name: str,
+        shard: bytes,
+        meta: dict,
+        dev=None,
     ) -> None:
         """The one shard-write shape (remove+touch+write+hinfo in a
-        single transaction), shared by put and recovery."""
+        single transaction), shared by put and recovery.  ``dev``
+        registers an already-resident device array (a batched-decode
+        output slice — device-born, zero extra transfer) instead of
+        the host bytes."""
         txn = Transaction()
         if store.exists(self.cid, name):
             txn.remove(self.cid, name)
@@ -340,9 +348,14 @@ class ECStore:
         # generation; any later txn on the shard invalidates it)
         from ..ops.residency import residency_cache
 
-        residency_cache().put_committed(
-            store, self.cid, name, data=shard
-        )
+        if dev is not None:
+            residency_cache().put_committed(
+                store, self.cid, name, dev=dev
+            )
+        else:
+            residency_cache().put_committed(
+                store, self.cid, name, data=shard
+            )
 
     # -- read path ---------------------------------------------------------
     def _shard_meta(self, name: str) -> dict:
@@ -682,7 +695,179 @@ class ECStore:
             read_bytes,
         )
 
-    # -- fault injection (the OSDThrasher role, §4.3) ----------------------
+    # -- batched recovery (ROADMAP open item 2) ----------------------------
+    def reconstruct_shards_batch(
+        self, names, shard: int, metas: dict | None = None
+    ):
+        """Rebuild ONE missing shard position for MANY objects through
+        a single coalesced decode-from-survivors dispatch (the
+        repair-side twin of the batched write path).  Survivor reads
+        honor ``minimum_to_decode`` — an LRC repair touches k_local ≪
+        k helpers, and the fan-in is MEASURED in the returned stats —
+        and consult the residency cache first (a survivor the encode
+        path just registered rides the dispatch with zero re-upload).
+
+        Returns (results, fallback, stats): ``results`` maps name →
+        (payload, meta) where payload is host bytes or a device-born
+        DeviceBuf, crc-verified against hinfo where it exists;
+        ``fallback`` lists names the batched path could not serve
+        (absent objects, fractional-repair profiles, short/corrupt
+        helpers) — callers route those through the per-op
+        :meth:`reconstruct_shard`, which widens and verifies.
+        ``stats`` counts survivor fan-in: ``survivor_shards`` (helper
+        shards consulted per the whole batch), ``read_bytes`` (bytes
+        actually read from stores — residency hits cost zero), and
+        ``residency_hits``."""
+        from ..ops.residency import (
+            residency_cache,
+            scrub_trusted as _scrub_trusted,
+        )
+        from ..ec.stripe import decode_batch
+
+        metas = metas or {}
+        results: dict[str, tuple] = {}
+        fallback: list[str] = []
+        stats = {
+            "survivor_shards": 0,
+            "read_bytes": 0,
+            "residency_hits": 0,
+        }
+        todo: list[str] = []
+        sets: list[dict] = []
+        obj_meta: dict[str, dict] = {}
+        # a position whose store errored once this batch is DEAD for
+        # the whole batch: re-probing it per object would hold the
+        # caller for a full sub-op timeout PER OBJECT (a freshly
+        # killed peer's session conn blocks, not refuses)
+        dead_positions: set[int] = set()
+        for name in dict.fromkeys(names):
+            meta = metas.get(name)
+            if meta is None:
+                try:
+                    meta = self._shard_meta(name)
+                except ErasureCodeError:
+                    fallback.append(name)
+                    continue
+            obj_meta[name] = meta
+            expected = self.sinfo.logical_to_next_chunk_offset(
+                meta["size"]
+            )
+            if expected == 0:
+                results[name] = (b"", meta)
+                continue
+            available = set()
+            for i in range(self.n):
+                if i == shard or i in dead_positions:
+                    continue
+                try:
+                    if self.stores[i].exists(self.cid, name):
+                        available.add(i)
+                except StoreError:
+                    dead_positions.add(i)
+            try:
+                minimum = self.ec.minimum_to_decode(
+                    {shard}, available
+                )
+            except ErasureCodeError:
+                fallback.append(name)
+                continue
+            sub = self.ec.get_sub_chunk_count()
+            if any(runs != [(0, sub)] for runs in minimum.values()):
+                # fractional (CLAY) repair: the per-op sub-chunk
+                # plumbing reads strictly less — never regress it to
+                # a whole-shard batch
+                fallback.append(name)
+                continue
+            survivors: dict[int, object] = {}
+            short = False
+            for pos in minimum:
+                store = self.stores[pos]
+                payload = None
+                if _scrub_trusted(store):
+                    payload = residency_cache().get(
+                        store, self.cid, name, expect_len=expected
+                    )
+                    if payload is not None:
+                        stats["residency_hits"] += 1
+                if payload is None:
+                    try:
+                        raw = store.read(self.cid, name)
+                    except StoreError:
+                        dead_positions.add(pos)
+                        short = True
+                        break
+                    if len(raw) != expected:
+                        short = True
+                        break
+                    stats["read_bytes"] += len(raw)
+                    payload = raw
+                survivors[pos] = payload
+            if short:
+                fallback.append(name)
+                continue
+            stats["survivor_shards"] += len(survivors)
+            todo.append(name)
+            sets.append(survivors)
+        if todo:
+            rebuilt = decode_batch(
+                self.sinfo, self.ec, sets, {shard}
+            )
+            for name, rec in zip(todo, rebuilt):
+                meta = obj_meta[name]
+                payload = rec[shard]
+                hashes = meta.get("hashes")
+                if hashes is not None:
+                    host = (
+                        payload.host()
+                        if hasattr(payload, "host")
+                        else bytes(payload)
+                    )
+                    if ceph_crc32c(0xFFFFFFFF, host) != hashes[shard]:
+                        # a silently-corrupt helper: the per-op
+                        # verified path filters it by crc
+                        fallback.append(name)
+                        continue
+                results[name] = (payload, meta)
+        return results, fallback, stats
+
+    def recover_objects_batch(self, names, shard: int) -> dict:
+        """Whole-PG rebuild of one dead shard position: batched
+        decode-from-survivors, then one shard-write per object —
+        reconstructed payloads registered device-born where the
+        device path ran (the next deep scrub digests them without a
+        transfer).  Objects the batched path cannot serve degrade to
+        the per-op verified :meth:`recover_shard` path.  Returns the
+        fan-in/throughput stats (plus ``objects``/``batched``)."""
+        tickets = {n: self._enter(n) for n in dict.fromkeys(names)}
+        try:
+            results, fallback, stats = self.reconstruct_shards_batch(
+                list(tickets), shard
+            )
+            for name, (payload, meta) in results.items():
+                if hasattr(payload, "host"):
+                    self._write_shard(
+                        self.stores[shard], name, payload.host(),
+                        meta, dev=payload.device(),
+                    )
+                else:
+                    self._write_shard(
+                        self.stores[shard], name, bytes(payload), meta
+                    )
+            recovered = 0
+            for name in fallback:
+                try:
+                    stats["read_bytes"] += self._recover_locked(
+                        name, shard
+                    )
+                    recovered += 1
+                except (ErasureCodeError, StoreError):
+                    pass  # absent everywhere / unreachable helpers
+            stats["objects"] = len(results) + recovered
+            stats["batched"] = len(results)
+            return stats
+        finally:
+            for name, ticket in tickets.items():
+                self._exit(name, ticket)
     def lose_shard(self, name: str, shard: int) -> None:
         self.stores[shard].queue_transaction(
             Transaction().remove(self.cid, name)

@@ -94,6 +94,23 @@ def _sample_messages():
         "MCommand": M.MCommand(
             cmd='{"prefix": "fault list"}'
         ),
+        # the recovery protocol (ISSUE 11): pull/push/reply + the
+        # two-sided reservation handshake — pinned so a
+        # recovery-message format drift fails the corpus gate
+        "MPGPull": M.MPGPull(
+            pgid="7.3", epoch=42, oid="obj-1", shard=2
+        ),
+        "MPGPush": M.MPGPush(
+            pgid="7.3", epoch=42, oid="obj-1", exists=True,
+            data=b"shard-bytes",
+            attrs={"hinfo_key": b'{"size": 11}', "u_color": b"teal"},
+            omap={"k1": b"v1"},
+            entry_blob=b"entry",
+        ),
+        "MPGPushReply": M.MPGPushReply(from_osd=2, ok=True),
+        "MRecoveryReserve": M.MRecoveryReserve(
+            op="request", pgid="7.3", epoch=42, from_osd=1
+        ),
     }
     for name, msg in samples.items():
         msg.tid = 99

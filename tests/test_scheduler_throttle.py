@@ -103,14 +103,23 @@ def test_throttle_blocks_fifo_and_get_or_fail():
     wait_parked(2)
     # a small later request must NOT barge past the parked large one
     assert order == []
-    t.put(8)  # 0 in flight: first (6) fits, then second (1)
+    # release in TWO steps so exactly one waiter fits at a time: the
+    # grant order is then observable in `order` without racing two
+    # simultaneously-woken threads' appends (the old single put(8)
+    # granted both under the lock — FIFO — but which THREAD appended
+    # first was scheduler weather, the ~1/5 flake)
+    t.put(4)  # 4 in flight: first (6) fits exactly, second (1) not
     a.join(10)
+    wait_parked(1, deadline=0.0)  # second still parked
+    assert order == ["first"]
+    assert t.current == 10
+    t.put(6)  # 4 in flight: second (1) fits
     b.join(10)
     assert order == ["first", "second"]
-    assert t.current == 7
+    assert t.current == 5
     # timeout path returns the budget untaken
     assert not t.get(100, timeout=0.05)
-    t.put(7)
+    t.put(5)
     assert t.get_or_fail(10)
 
 

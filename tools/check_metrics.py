@@ -84,6 +84,20 @@ RESIDENCY_COUNTERS = (
     "l_tpu_residency_bytes_resident",
     "l_tpu_batch_encode_dispatches",
     "l_tpu_batch_encode_ops_per_dispatch",
+    "l_tpu_batch_decode_dispatches",
+    "l_tpu_batch_decode_ops_per_dispatch",
+)
+# recovery-storm counters the OSD schema must declare (the
+# l_osd_recovery_* block: batched decode rebuild progress + the
+# survivor-read fan-in the LRC locality claim is measured from)
+RECOVERY_COUNTERS = (
+    "recovery_active",
+    "recovery_pushes",
+    "recovery_push_bytes",
+    "recovery_batches",
+    "recovery_batch_ops",
+    "recovery_survivor_shards",
+    "recovery_helper_bytes",
 )
 
 CRASH_REQUIRED = (
@@ -317,6 +331,20 @@ def check_fault_counters() -> list[str]:
         if name not in osd_declared
     )
     return errors
+
+
+def check_recovery_counters() -> list[str]:
+    """The recovery-storm plane: the OSD schema's l_osd_recovery_*
+    block (bench.py's recovery section and the LRC fan-in assertion
+    read exactly these)."""
+    from ceph_tpu.osd.daemon import build_osd_perf
+
+    declared = set(build_osd_perf(0)._counters)
+    return [
+        f"osd schema: recovery counter {name!r} missing"
+        for name in RECOVERY_COUNTERS
+        if name not in declared
+    ]
 
 
 def check_residency_counters() -> list[str]:
@@ -622,6 +650,7 @@ def check_all(sets=None) -> list[str]:
         errors.extend(check_scrub_counters())
         errors.extend(check_fault_counters())
         errors.extend(check_residency_counters())
+        errors.extend(check_recovery_counters())
         errors.extend(product_histogram_exposition())
     return errors
 
