@@ -1170,6 +1170,113 @@ def measure_scrub() -> dict:
     }
 
 
+def measure_msgr() -> dict:
+    """Messenger plane on the shared network stack (ISSUE 14):
+    messages/s and dispatch p50/p99 at 3, 16, and 100 in-process
+    daemons, with the process thread count at each rung — the curve
+    that shows thread cost stays flat while daemon count grows.
+    Entirely CPU-side (no device kernels anywhere near the path)."""
+    import threading as _threading
+
+    from ceph_tpu.msg import Messenger, MPing
+    from ceph_tpu.msg.messenger import Dispatcher
+    from ceph_tpu.msg.stack import NetworkStack
+
+    class _Echo(Dispatcher):
+        def ms_dispatch(self, conn, msg):
+            if isinstance(msg, MPing) and not msg.is_reply:
+                conn.send(
+                    MPing(
+                        tid=msg.tid, from_osd=0, stamp=msg.stamp,
+                        is_reply=True,
+                    )
+                )
+                return True
+            return False
+
+    def rung(n_daemons: int, duration: float = 2.0) -> dict:
+        msgrs = []
+        clients = []
+        try:
+            for i in range(n_daemons):
+                m = Messenger(f"bench-d{i}")
+                m.add_dispatcher(_Echo())
+                m.bind()
+                msgrs.append(m)
+            n_cli = 4
+            lats: list[float] = []
+            lock = _threading.Lock()
+            stop = _threading.Event()
+
+            def drive(widx: int):
+                cli = Messenger(f"bench-c{widx}")
+                clients.append(cli)
+                conns = [
+                    cli.connect(*m.bound_addr)
+                    for m in msgrs[widx::n_cli] or msgrs[:1]
+                ]
+                mine: list[float] = []
+                k = 0
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    conns[k % len(conns)].call(
+                        MPing(stamp=1.0), timeout=10.0
+                    )
+                    mine.append(time.perf_counter() - t0)
+                    k += 1
+                with lock:
+                    lats.extend(mine)
+
+            threads = [
+                _threading.Thread(target=drive, args=(w,), daemon=True)
+                for w in range(n_cli)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            time.sleep(duration)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            dt = time.perf_counter() - t0
+            stack = NetworkStack.live()
+            s = sorted(lats) or [0.0]
+            return {
+                "daemons": n_daemons,
+                "msgs_per_s": round(len(lats) / dt, 1),
+                "dispatch_p50_ms": round(
+                    s[len(s) // 2] * 1000, 3
+                ),
+                "dispatch_p99_ms": round(
+                    s[min(len(s) - 1, int(len(s) * 0.99))] * 1000, 3
+                ),
+                "threads": _threading.active_count(),
+                "stack_workers": (
+                    len(stack.workers) if stack else 0
+                ),
+                "stack_offload": (
+                    stack.offload.size if stack else 0
+                ),
+            }
+        finally:
+            for m in clients + msgrs:
+                try:
+                    m.shutdown()
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+
+    curve = [rung(n) for n in (3, 16, 100)]
+    for row in curve:
+        _log(
+            f"msgr @{row['daemons']:>3} daemons: "
+            f"{row['msgs_per_s']:.0f} msg/s, dispatch p50 "
+            f"{row['dispatch_p50_ms']}ms p99 "
+            f"{row['dispatch_p99_ms']}ms, {row['threads']} threads "
+            f"({row['stack_workers']} workers)"
+        )
+    return {"msgr": curve}
+
+
 def measure_recovery(on_tpu: bool) -> dict:
     """Recovery-storm plane (ROADMAP open item 2): decode-from-
     survivors rebuild throughput before/after the coalesced batched
@@ -1658,6 +1765,17 @@ def main(argv=None) -> None:
             vs_baseline=round(gbs / ISAL_CLASS_GBPS, 2),
             kernel=kern,
         )
+        # messenger-plane curve: entirely CPU-side, so it runs even
+        # when no device backend exists at all (be == "none")
+        try:
+            out.update(measure_msgr())
+        except Exception as e:  # noqa: BLE001 — one section must not
+            # eat the artifact (own key: this section is CPU-side, a
+            # failure here says nothing about the device backend)
+            import traceback
+
+            traceback.print_exc()
+            out["msgr_error"] = f"{type(e).__name__}: {e}"
         if be != "none":
             # families BEFORE the big crush compiles: the remote
             # compile service degrades late in a long session, and

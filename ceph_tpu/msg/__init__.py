@@ -23,6 +23,7 @@ AsyncMessenger plays beneath the OSDs.
 """
 
 from .faults import FaultInjector, FaultRule, build_msgr_perf
+from .stack import NetworkStack, build_stack_perf, stack_perf_dump
 from .message import (
     MCommand,
     MECSubRead,
@@ -94,6 +95,9 @@ __all__ = [
     "Message",
     "MessageError",
     "Messenger",
+    "NetworkStack",
     "build_msgr_perf",
+    "build_stack_perf",
     "register_message",
+    "stack_perf_dump",
 ]

@@ -1,10 +1,15 @@
 """AsyncMessenger — asyncio connection fabric behind the Messenger
 contract (src/msg/Messenger.h:89,393-425; src/msg/async/AsyncMessenger.h).
 
-One Messenger owns one asyncio event loop on a daemon thread (the
-EventCenter role).  ``bind()`` starts a TCP listener; ``connect()``
-dials out.  Both directions speak the same framed protocol
-(message.py): a fixed banner exchange, then crc-framed typed messages.
+A Messenger is a lightweight façade over the process-wide
+``NetworkStack`` (msg/stack.py — the reference's NetworkStack/Worker
+pool): at ``start()`` it checks out ONE shared event-loop worker by
+least-connections, and every listener, connection, read loop and
+timer of this messenger then multiplexes onto that worker's loop
+alongside other daemons' messengers.  ``bind()`` starts a TCP
+listener; ``connect()`` dials out.  Both directions speak the same
+framed protocol (message.py): a fixed banner exchange, then
+crc-framed typed messages.
 
 Dispatch mirrors the reference: inbound messages walk the dispatcher
 chain until one claims the type (ms_dispatch); connection teardown
@@ -12,9 +17,19 @@ notifies ms_handle_reset.  RPC-style request/reply (the sub-op
 pattern) is provided by ``Connection.call`` — the reply is paired by
 tid, exactly how ECBackend matches sub-op replies to in-flight ops.
 
+Because the loop is SHARED, dispatch never runs on it: inbound
+messages (and reset notifications) drain FIFO through a per-messenger
+serial strand on the stack's elastic offload pool — a blocking
+handler stalls only its own messenger's queue, never a worker, and
+nested blocking RPC from handlers (which would deadlock a read loop
+waiting on itself) is safe.  Tid-paired ``call`` replies resolve
+directly on the read loop and never wait behind dispatch.
+
 The API is synchronous on purpose: callers (stores, daemons, tests)
-are plain Python; every sync call marshals onto the loop thread via
-``run_coroutine_threadsafe``.
+are plain Python; every sync call marshals onto the worker loop via
+``run_coroutine_threadsafe``.  Per-messenger single-loop affinity is
+what keeps the FaultInjector's seeded RNG single-threaded, so chaos
+decision streams replay byte-identically on the shared stack.
 """
 
 from __future__ import annotations
@@ -26,6 +41,7 @@ import time
 
 from .faults import FaultInjector
 from .message import Message, MessageError
+from .stack import NetworkStack
 
 BANNER = b"ceph-tpu-msgr/2\n"
 _CALL_TIMEOUT = 30.0
@@ -205,8 +221,9 @@ class Connection:
         if plan.delay > 0.0:
             # deliver later off a task: ordering vs frames sent in
             # the meantime is deliberately NOT preserved (netem
-            # delay/reorder semantics)
-            self.msgr._loop.create_task(
+            # delay/reorder semantics).  Tracked so shutdown cancels
+            # it instead of leaving it pending on the SHARED loop.
+            self.msgr._spawn(
                 self._delayed_send(msg, plan.delay, plan.duplicate)
             )
             return
@@ -352,7 +369,16 @@ class Messenger:
         self.secure = secure
         self.name = name
         self._loop: asyncio.AbstractEventLoop | None = None
-        self._thread: threading.Thread | None = None
+        self._stack: NetworkStack | None = None
+        self._worker = None  # the checked-out stack Worker
+        self._start_lock = threading.Lock()
+        # tasks THIS messenger created on the shared loop (read
+        # loops, delayed sends, in-flight dials): shutdown cancels
+        # exactly these — never another messenger's
+        self._tasks: set = set()
+        # dispatch-offload strand (created at start)
+        self._dispatch_strand = None
+        self._shut = False  # shutdown() is terminal
         self._server: asyncio.AbstractServer | None = None
         self._dispatchers: list[Dispatcher] = []
         self._conns: set[Connection] = set()
@@ -413,15 +439,82 @@ class Messenger:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
-        if self._loop is not None:
-            return
-        self._loop = asyncio.new_event_loop()
-        self._thread = threading.Thread(
-            target=self._loop.run_forever,
-            name=f"msgr-{self.name}",
-            daemon=True,
-        )
-        self._thread.start()
+        with self._start_lock:
+            if self._worker is not None:
+                return
+            if self._shut:
+                # TERMINAL shutdown: a background reconnect racing
+                # teardown must not resurrect this messenger onto a
+                # (possibly different) worker — half its state would
+                # still be bound to the old loop
+                raise MessageError("messenger shut down")
+            while True:
+                # a stack latching teardown between instance() and
+                # checkout() hands back None: retry on the fresh
+                # generation instead of adopting a dying loop
+                stack = NetworkStack.instance()
+                worker = stack.checkout(self)
+                if worker is not None:
+                    break
+            self._stack = stack
+            self._worker = worker
+            self._loop = worker.loop
+            self._dispatch_strand = stack.offload.strand()
+
+    # -- shared-loop task bookkeeping --------------------------------------
+    def _track(self, task: asyncio.Task) -> None:
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    def _spawn(self, coro) -> asyncio.Task:
+        """create_task + track (loop thread only).  Falls back to
+        the running loop when shutdown cleared self._loop under a
+        task still in flight — the task is tracked either way, so it
+        dies with the worker at the latest."""
+        loop = self._loop
+        if loop is None:
+            loop = asyncio.get_running_loop()
+        task = loop.create_task(coro)
+        self._track(task)
+        return task
+
+    def _run_tracked(self, coro, timeout: float):
+        """Run a coroutine on the worker loop as a TRACKED task and
+        wait for its result — used for dials/binds so an in-flight
+        attempt is cancelled by shutdown() instead of lingering on
+        the shared loop."""
+        loop = self._loop
+        if loop is None:
+            coro.close()
+            raise MessageError("messenger not started")
+        cf: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _schedule():
+            task = loop.create_task(coro)
+            self._track(task)
+
+            def _transfer(t: asyncio.Task):
+                if cf.set_running_or_notify_cancel():
+                    try:
+                        exc = t.exception()
+                    except asyncio.CancelledError:
+                        # task cancelled (shutdown raced the dial):
+                        # surface a catchable error, not the
+                        # BaseException-derived CancelledError
+                        exc = MessageError("cancelled by shutdown")
+                    if exc is not None:
+                        cf.set_exception(exc)
+                    else:
+                        cf.set_result(t.result())
+
+            task.add_done_callback(_transfer)
+
+        try:
+            loop.call_soon_threadsafe(_schedule)
+        except RuntimeError as e:  # shared loop stopping under us
+            coro.close()
+            raise MessageError(f"messenger stopping: {e}") from e
+        return cf.result(timeout)
 
     def bind(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
         """Listen; returns the bound (host, port)."""
@@ -439,7 +532,7 @@ class Messenger:
             )
             return self._server.sockets[0].getsockname()[:2]
 
-        self.bound_addr = self._run(_serve()).result(10)
+        self.bound_addr = self._run_tracked(_serve(), 10)
         return self.bound_addr
 
     def connect(
@@ -506,12 +599,18 @@ class Messenger:
                     nonce,
                     outgoing=True,
                 )
-            self._conns.add(conn)
-            self._loop.create_task(conn._read_loop())
+            if self._shut:
+                # a dial landing after shutdown's cancel sweep must
+                # not register a connection nobody will ever read or
+                # close (the fd would leak until stack teardown)
+                writer.close()
+                raise MessageError("messenger shut down")
+            self._register_conn(conn)
+            self._spawn(conn._read_loop())
             return conn
 
         try:
-            return self._run(_dial()).result(timeout)
+            return self._run_tracked(_dial(), timeout)
         except MessageError:
             raise
         except (Exception, concurrent.futures.CancelledError) as e:
@@ -520,8 +619,10 @@ class Messenger:
             ) from e
 
     def shutdown(self) -> None:
-        if self._loop is None:
-            return
+        with self._start_lock:
+            self._shut = True
+            if self._worker is None:
+                return
 
         async def _stop():
             if self._server is not None:
@@ -538,20 +639,37 @@ class Messenger:
                     )
                 except Exception:
                     pass
-            # Cancel anything still in flight on this loop (dials that
-            # never completed, lingering read loops) so pytest exits with
-            # no "Task was destroyed but it is pending" warnings.
+            # Cancel what THIS messenger still has in flight (dials
+            # that never completed, lingering read loops, delayed
+            # fault sends) — the loop is shared, so only our own
+            # tracked tasks are fair game.
             me = asyncio.current_task()
-            pending = [t for t in asyncio.all_tasks() if t is not me]
+            pending = [
+                t for t in list(self._tasks)
+                if t is not me and not t.done()
+            ]
             for t in pending:
                 t.cancel()
-            await asyncio.gather(*pending, return_exceptions=True)
+            if pending:
+                # BOUNDED: a task slow to honor its cancellation (a
+                # banner-less accepted socket mid-timeout, a wedged
+                # transport) must not eat the caller's whole shutdown
+                # budget — leftovers are already cancelled and die
+                # with the worker at stack teardown
+                await asyncio.wait(pending, timeout=5.0)
 
-        self._run(_stop()).result(10)
-        self._loop.call_soon_threadsafe(self._loop.stop)
-        self._thread.join(timeout=5)
-        self._loop.close()
-        self._loop = None
+        try:
+            self._run(_stop()).result(10)
+        finally:
+            with self._start_lock:
+                stack, worker = self._stack, self._worker
+                self._loop = None
+                self._worker = None
+                self._stack = None
+                self._server = None
+            if stack is not None:
+                # last release tears the worker loops down
+                stack.release(worker)
 
     def __enter__(self):
         self.start()
@@ -567,6 +685,21 @@ class Messenger:
         self._dispatchers.append(d)
 
     def _dispatch(self, conn: Connection, msg: Message) -> None:
+        """Queue one inbound message onto this messenger's dispatch
+        strand (the dispatch-offload seam): handlers run FIFO on the
+        stack's offload pool, never on the shared worker loop — a
+        blocking handler stalls this messenger's queue, not a worker,
+        and may safely make nested blocking RPC."""
+        worker = self._worker
+        if worker is not None:
+            worker.count_dispatch()
+        strand = self._dispatch_strand
+        if strand is None:
+            # racing shutdown: nobody left to deliver to
+            return
+        strand.submit(lambda: self._dispatch_now(conn, msg))
+
+    def _dispatch_now(self, conn, msg: Message) -> None:
         # trace propagation (the ZTracer trace-info handoff): a
         # message carrying a span/trace id makes it ambient for its
         # handlers, so spans they open join the sender's trace
@@ -592,8 +725,27 @@ class Messenger:
                 traceback.print_exc()
                 return
 
+    def _register_conn(self, conn: Connection) -> None:
+        """Loop-thread bookkeeping for a new live connection."""
+        self._conns.add(conn)
+        if self._worker is not None:
+            self._worker.conn_opened()
+
     def _conn_reset(self, conn: Connection) -> None:
-        self._conns.discard(conn)
+        if conn in self._conns:
+            self._conns.discard(conn)
+            if self._worker is not None:
+                self._worker.conn_closed()
+        # reset notifications ride the dispatch strand so dispatchers
+        # observe them AFTER every message already queued from this
+        # connection — the ordering inline dispatch used to give
+        strand = self._dispatch_strand
+        if strand is not None:
+            strand.submit(lambda: self._conn_reset_now(conn))
+        else:
+            self._conn_reset_now(conn)
+
+    def _conn_reset_now(self, conn: Connection) -> None:
         for d in self._dispatchers:
             try:
                 d.ms_handle_reset(conn)
@@ -615,11 +767,21 @@ class Messenger:
             return self._tid * 2
 
     def _run(self, coro):
-        if self._loop is None:
+        loop = self._loop
+        if loop is None:
+            coro.close()  # no loop: silence the never-awaited warning
             raise MessageError("messenger not started")
-        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+        try:
+            return asyncio.run_coroutine_threadsafe(coro, loop)
+        except RuntimeError as e:  # shared loop stopping under us
+            coro.close()
+            raise MessageError(f"messenger stopping: {e}") from e
 
     async def _accept(self, reader, writer) -> None:
+        # the server spawned this handler as its own task on the
+        # shared loop: track it so shutdown() cancels it with the
+        # rest of this messenger's work
+        self._track(asyncio.current_task())
         peer_entity = ""
         try:
             writer.write(BANNER)
@@ -683,7 +845,7 @@ class Messenger:
         conn = Connection(self, reader, writer, outgoing=False)
         conn.secure = secure_ctx
         conn.peer_entity = peer_entity
-        self._conns.add(conn)
+        self._register_conn(conn)
         await conn._read_loop()
 
 

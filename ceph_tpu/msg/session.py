@@ -166,6 +166,10 @@ class SessionConnection:
         self._pending: dict[int, concurrent.futures.Future] = {}
         self._plock = threading.Lock()
         self._closed = False
+        # proactive reconnect state: one redial attempt in flight at
+        # a time, kicked by the transport's reset notification
+        self._redial_lock = threading.Lock()
+        self._redialing = False
 
     # -- Connection API ----------------------------------------------------
     @property
@@ -279,6 +283,44 @@ class SessionConnection:
                 self._conn = conn
             self.msgr.session_client_register(conn, self)
             return conn
+
+    def on_transport_reset(self) -> None:
+        """Event-driven reconnect (the replay-window determinism
+        fix): the instant the transport dies with work outstanding —
+        unacked frames to replay or calls awaiting replies — redial,
+        re-handshake and replay ONCE, off the messenger loop.  The
+        replay window is then exactly the death-to-redial handshake,
+        not however long the caller's poll loop took to notice; each
+        death triggers exactly one immediate replay attempt, and a
+        failed attempt (peer really down) leaves recovery to the
+        callers' retry loops as before."""
+        if self._closed:
+            return
+        with self._plock:
+            has_pending = bool(self._pending)
+        if not has_pending and not self.state.unacked:
+            return
+        with self._redial_lock:
+            if self._redialing:
+                return
+            self._redialing = True
+        stack = self.msgr._stack
+
+        def _redial():
+            try:
+                if not self._closed:
+                    self._ensure()
+            except (MessageError, OSError):
+                pass
+            finally:
+                with self._redial_lock:
+                    self._redialing = False
+
+        if stack is not None:
+            stack.offload.submit(_redial)
+        else:  # messenger already torn down
+            with self._redial_lock:
+                self._redialing = False
 
     # -- inbound (called by the messenger's session dispatcher) -----------
     def handle_envelope(self, conn: Connection, env: MSessionData):
@@ -520,4 +562,9 @@ class SessionService(Dispatcher):
 
     def ms_handle_reset(self, conn: Connection) -> None:
         with self._lock:
-            self._by_conn.pop(id(conn), None)
+            ep = self._by_conn.pop(id(conn), None)
+        # a dialer-side endpoint reconnects/replays NOW rather than
+        # waiting for a caller's poll to notice the dead socket
+        kick = getattr(ep, "on_transport_reset", None)
+        if kick is not None:
+            kick()

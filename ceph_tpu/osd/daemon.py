@@ -367,6 +367,7 @@ class OSD(Dispatcher):
         client_message_cap: int = 256 << 20,
         op_queue: str = "wpq",
         qos_profiles: dict | None = None,
+        shared_services: bool | None = None,
     ):
         """``scrub_interval`` > 0 arms tick-driven scrub scheduling
         (osd_scrub_min_interval); ``deep_scrub_interval`` spaces the
@@ -377,8 +378,28 @@ class OSD(Dispatcher):
         osd_scrub_auto_repair config; ``max_backfills`` caps
         concurrent per-(pg, peer) recoveries on BOTH sides of the
         reservation protocol (osd_max_backfills) — individual pushes
-        serialize through the op scheduler's RECOVERY class."""
+        serialize through the op scheduler's RECOVERY class.
+
+        ``shared_services`` (default CEPH_TPU_SHARED_SERVICES, off)
+        moves this daemon's worker/tick/mgr-report threads onto the
+        shared NetworkStack (a serial strand for the op queue, stack
+        timers for the periodic loops): per-daemon thread cost drops
+        to ZERO, which is what lets tests/scale.py run 100 OSDs in
+        one process with a thread count independent of daemon
+        count."""
+        import os as _os
+
         self.whoami = whoami
+        if shared_services is None:
+            shared_services = (
+                _os.environ.get("CEPH_TPU_SHARED_SERVICES", "0")
+                == "1"
+            )
+        self.shared_services = bool(shared_services)
+        self._service_timers: list = []
+        self._op_strand = None
+        self._workq_kicked = False
+        self._workq_kick_lock = threading.Lock()
         self.store = store or MemStore()
         self.messenger = Messenger(f"osd.{whoami}")
         self.messenger.add_dispatcher(self)
@@ -547,6 +568,10 @@ class OSD(Dispatcher):
         self._pending_crashes: deque = deque(maxlen=16)
         self._crash_sends: dict[str, int] = {}
         self.CRASH_RESEND_COUNT = 3
+        # how often to re-ask the mon who the active mgr is while
+        # none is known (scale harnesses stretch it: it is O(n) mon
+        # commands per interval across a big cluster)
+        self.mgr_discovery_interval = 5.0
         self._mgr_addr: str | None = None
         self._mgr_conn = None
         self._mgr_addr_checked = 0.0
@@ -585,6 +610,10 @@ class OSD(Dispatcher):
         self._backoff_lock = threading.Lock()
         # store statfs is a walk — cache it at ~tick rate
         self._statfs_cache: tuple[float, dict] | None = None
+        # ~1 Hz stat reports by default; 100-daemon clusters stretch
+        # this (tests/scale.py) so the mon isn't saturated by O(n)
+        # commands per second on one core
+        self.stat_report_interval = 1.0
         self._stat_report_last = 0.0
         self._stat_report_inflight = False
         # the mon's EFFECTIVE full ratio, learned from the stat-report
@@ -615,33 +644,68 @@ class OSD(Dispatcher):
         (host, port)) enables failover across a monitor quorum."""
         self.addr = self.messenger.bind()
         self._load_pgs()
-        self._worker = threading.Thread(
-            target=self._work_loop, name=f"osd.{self.whoami}.wq",
-            daemon=True,
-        )
-        self._worker.start()
+        if self.shared_services:
+            # zero per-daemon threads: the op queue drains through a
+            # serial strand on the stack's offload pool (kicked by
+            # the scheduler's enqueue hook), tick + mgr-report ride
+            # stack timers with overlap guards
+            stack = self._stack()
+            self._op_strand = stack.offload.strand()
+            self._workq.on_enqueue = self._kick_workq
+        else:
+            self._worker = threading.Thread(
+                target=self._work_loop, name=f"osd.{self.whoami}.wq",
+                daemon=True,
+            )
+            self._worker.start()
         if mon_addrs is not None:
             self.monc.connect_any(mon_addrs)
         else:
             self.monc.connect(mon_host, mon_port)
         self.monc.boot(self.whoami, addr=f"{self.addr[0]}:{self.addr[1]}")
-        self._ticker = threading.Thread(
-            target=self._tick_loop, name=f"osd.{self.whoami}.tick",
-            daemon=True,
-        )
-        self._ticker.start()
-        self._mgr_reporter = threading.Thread(
-            target=self._mgr_report_loop,
-            name=f"osd.{self.whoami}.mgrreport",
-            daemon=True,
-        )
-        self._mgr_reporter.start()
+        if self.shared_services:
+            stack = self._stack()
+            self._service_timers.append(
+                stack.timers.every(self.tick_interval, self._tick_safe)
+            )
+            self._service_timers.append(
+                stack.timers.every(1.0, self._mgr_report_safe)
+            )
+        else:
+            self._ticker = threading.Thread(
+                target=self._tick_loop, name=f"osd.{self.whoami}.tick",
+                daemon=True,
+            )
+            self._ticker.start()
+            self._mgr_reporter = threading.Thread(
+                target=self._mgr_report_loop,
+                name=f"osd.{self.whoami}.mgrreport",
+                daemon=True,
+            )
+            self._mgr_reporter.start()
+
+    def _stack(self):
+        from ..msg.stack import NetworkStack
+
+        return NetworkStack.instance()
 
     def shutdown(self) -> None:
         self._stop.set()
+        for handle in self._service_timers:
+            handle.cancel()
+        self._service_timers = []
         self._workq.put(None)
         if self._worker is not None:
             self._worker.join(timeout=5)
+        if self._op_strand is not None:
+            # let an in-flight drained item finish, then stop feeding
+            deadline = time.monotonic() + 5.0
+            while (
+                not self._op_strand.idle
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            self._workq.on_enqueue = None
         if self.admin is not None:
             self.admin.stop()
         self.messenger.shutdown()
@@ -3292,7 +3356,7 @@ class OSD(Dispatcher):
         a partitioned mon must not stall the heartbeat path — ticks
         blocked behind a 2s command timeout would make THIS OSD file
         spurious failure reports for every reachable peer."""
-        if now - self._stat_report_last < 1.0:
+        if now - self._stat_report_last < self.stat_report_interval:
             return
         self._stat_report_last = now
         stats = self.statfs()
@@ -3302,12 +3366,19 @@ class OSD(Dispatcher):
         if self._stat_report_inflight:
             return
         self._stat_report_inflight = True
-        threading.Thread(
-            target=self._send_stat_report,
-            args=(stats,),
-            name=f"osd.{self.whoami}.statrep",
-            daemon=True,
-        ).start()
+        if self.shared_services:
+            # ride the shared offload pool: no short-lived thread per
+            # report at 100-daemon scale
+            self._stack().offload.submit(
+                lambda: self._send_stat_report(stats)
+            )
+        else:
+            threading.Thread(
+                target=self._send_stat_report,
+                args=(stats,),
+                name=f"osd.{self.whoami}.statrep",
+                daemon=True,
+            ).start()
 
     def _commit_latency_ms(self) -> float:
         """Mean commit latency since the last stat report (the
@@ -3364,8 +3435,11 @@ class OSD(Dispatcher):
             elif prefix == "dump_backoffs":
                 reply.outb = json.dumps(self.dump_backoffs())
             elif prefix == "perf dump":
+                from ..msg.stack import stack_perf_dump
+
                 dump = dict(self.perf.dump())
                 dump.update(self.messenger.faults.perf.dump())
+                dump.update(stack_perf_dump())
                 reply.outb = json.dumps(dump)
             elif prefix == "perf histogram dump":
                 # the `ceph daemonperf`/`perf histogram dump` tell
@@ -3605,92 +3679,147 @@ class OSD(Dispatcher):
             item = self._workq.get()
             if item is None:
                 return
-            kind = item[0]
+            self._process_work_item(item)
+
+    # -- shared-services drain (strand-kicked, no dedicated thread) --------
+    def _kick_workq(self) -> None:
+        with self._workq_kick_lock:
+            if self._workq_kicked:
+                return
+            self._workq_kicked = True
+        self._op_strand.submit(self._drain_workq)
+
+    def _drain_workq(self) -> None:
+        """Drain the op scheduler until empty on the offload strand —
+        serial per daemon (the exact single-worker-thread semantics),
+        but on a shared pool thread only while there is work."""
+        with self._workq_kick_lock:
+            self._workq_kicked = False
+        while not self._stop.is_set():
             try:
-                if kind == "map":
-                    self._walk_pgs(item[1])
-                elif kind == "op":
-                    extra = self._coalesce_op_items(item)
-                    if extra:
-                        self._handle_op_batch([item] + extra)
-                    else:
-                        try:
-                            self._handle_op(item[1], item[2])
-                        finally:
-                            self.client_throttle.put(item[3])
-                elif kind == "activate":
-                    self._apply_activate(item[1], item[2])
-                elif kind == "pull":
-                    self._handle_pull(item[1], item[2])
-                elif kind == "recover_push":
-                    extra = self._coalesce_recovery_items(item)
-                    if extra:
-                        self._do_recover_push_batch([item] + extra)
-                    else:
-                        self._do_recover_push(item[1], item[2])
-                elif kind == "split":
-                    pg = self.pgs.get(item[1])
-                    if (
-                        pg is not None
-                        and pg.primary == self.whoami
-                        and pg.state == "active"
-                        and item[1] not in self._splitting
-                    ):
-                        # the scan blocks on PEER primaries (who may
-                        # be splitting toward us at the same moment):
-                        # a side thread keeps this worker serving ops,
-                        # breaking the mutual-starvation cycle; local
-                        # mutations marshal back via _on_worker
-                        self._splitting.add(item[1])
+                item = self._workq.get(timeout=0)
+            except TimeoutError:
+                if self._workq.qlen() > 0:
+                    # heads exist but are rate-limited (mclock tags
+                    # not yet due): come back shortly instead of
+                    # parking a pool thread on the condvar
+                    self._stack().timers.after(0.01, self._kick_workq)
+                return
+            if item is None:
+                return  # draining for shutdown
+            self._process_work_item(item)
 
-                        def run(pg=pg, epoch=item[2], pgid=item[1]):
-                            try:
-                                self._split_scan(pg, epoch)
-                            finally:
-                                self._splitting.discard(pgid)
+    def _tick_safe(self) -> None:
+        if self._stop.is_set():
+            return
+        try:
+            self._tick()
+        except Exception as e:  # noqa: BLE001 — same containment as
+            # the dedicated tick thread: a tick crash is reportable,
+            # the timer keeps firing
+            crash_util.capture(
+                f"osd.{self.whoami}",
+                e,
+                sink=self._pending_crashes,
+                clog=self.clog,
+                extra_meta={"thread": "tick"},
+            )
 
-                        threading.Thread(
-                            target=run,
-                            name=f"osd.{self.whoami}.split",
-                            daemon=True,
-                        ).start()
-                elif kind == "splitcall":
-                    _k, fn, fut = item
+    def _mgr_report_safe(self) -> None:
+        if self._stop.is_set():
+            return
+        try:
+            self._report_to_mgr()
+        except Exception:  # noqa: BLE001 — reporting best-effort
+            pass
+
+    def _process_work_item(self, item) -> None:
+        kind = item[0]
+        try:
+            if kind == "map":
+                self._walk_pgs(item[1])
+            elif kind == "op":
+                extra = self._coalesce_op_items(item)
+                if extra:
+                    self._handle_op_batch([item] + extra)
+                else:
                     try:
-                        fut.set_result(fn())
-                    except Exception as e:  # noqa: BLE001
-                        fut.set_exception(e)
-                elif kind == "tier_agent":
-                    pg = self.pgs.get(item[1])
-                    try:
-                        if pg is not None:
-                            self._tier_agent(pg)
+                        self._handle_op(item[1], item[2])
                     finally:
-                        self._tier_running.discard(item[1])
-                elif kind == "scrub":
-                    pg = self.pgs.get(item[1])
-                    if pg is None:
-                        self._scrubbing.discard(item[1])
-                    else:
-                        # one CHUNK per work item: the scrubber
-                        # re-enqueues itself until done, so client
-                        # ops interleave between chunks (scrub
-                        # preemption); it owns the _scrubbing guard
-                        self.scrubber.run(pg, item[2], item[3])
-            except Exception as e:  # noqa: BLE001 — worker must
-                # survive, but the death of the op IS a daemon crash:
-                # capture traceback + dout tail for the mgr crash
-                # module and announce it on the cluster log
-                import traceback
+                        self.client_throttle.put(item[3])
+            elif kind == "activate":
+                self._apply_activate(item[1], item[2])
+            elif kind == "pull":
+                self._handle_pull(item[1], item[2])
+            elif kind == "recover_push":
+                extra = self._coalesce_recovery_items(item)
+                if extra:
+                    self._do_recover_push_batch([item] + extra)
+                else:
+                    self._do_recover_push(item[1], item[2])
+            elif kind == "split":
+                pg = self.pgs.get(item[1])
+                if (
+                    pg is not None
+                    and pg.primary == self.whoami
+                    and pg.state == "active"
+                    and item[1] not in self._splitting
+                ):
+                    # the scan blocks on PEER primaries (who may
+                    # be splitting toward us at the same moment):
+                    # a side thread keeps this worker serving ops,
+                    # breaking the mutual-starvation cycle; local
+                    # mutations marshal back via _on_worker
+                    self._splitting.add(item[1])
 
-                traceback.print_exc()
-                crash_util.capture(
-                    f"osd.{self.whoami}",
-                    e,
-                    sink=self._pending_crashes,
-                    clog=self.clog,
-                    extra_meta={"work_item": str(kind)},
-                )
+                    def run(pg=pg, epoch=item[2], pgid=item[1]):
+                        try:
+                            self._split_scan(pg, epoch)
+                        finally:
+                            self._splitting.discard(pgid)
+
+                    threading.Thread(
+                        target=run,
+                        name=f"osd.{self.whoami}.split",
+                        daemon=True,
+                    ).start()
+            elif kind == "splitcall":
+                _k, fn, fut = item
+                try:
+                    fut.set_result(fn())
+                except Exception as e:  # noqa: BLE001
+                    fut.set_exception(e)
+            elif kind == "tier_agent":
+                pg = self.pgs.get(item[1])
+                try:
+                    if pg is not None:
+                        self._tier_agent(pg)
+                finally:
+                    self._tier_running.discard(item[1])
+            elif kind == "scrub":
+                pg = self.pgs.get(item[1])
+                if pg is None:
+                    self._scrubbing.discard(item[1])
+                else:
+                    # one CHUNK per work item: the scrubber
+                    # re-enqueues itself until done, so client
+                    # ops interleave between chunks (scrub
+                    # preemption); it owns the _scrubbing guard
+                    self.scrubber.run(pg, item[2], item[3])
+        except Exception as e:  # noqa: BLE001 — worker must
+            # survive, but the death of the op IS a daemon crash:
+            # capture traceback + dout tail for the mgr crash
+            # module and announce it on the cluster log
+            import traceback
+
+            traceback.print_exc()
+            crash_util.capture(
+                f"osd.{self.whoami}",
+                e,
+                sink=self._pending_crashes,
+                clog=self.clog,
+                extra_meta={"work_item": str(kind)},
+            )
 
     def _peers_of_interest(self) -> set[int]:
         peers: set[int] = set()
@@ -3717,12 +3846,18 @@ class OSD(Dispatcher):
         active mgr through the monitor at a slow cadence, keep one
         cached connection, drop it on any failure."""
         now = time.monotonic()
-        if self._mgr_addr is None and now - self._mgr_addr_checked < 5.0:
+        gate = self.mgr_discovery_interval
+        if self._mgr_addr is None and now - self._mgr_addr_checked < gate:
             return
         try:
-            if self._mgr_addr is None or now - self._mgr_addr_checked > 5.0:
+            if self._mgr_addr is None or now - self._mgr_addr_checked > gate:
                 self._mgr_addr_checked = now
-                reply = self.monc.command({"prefix": "mgr stat"})
+                # SHORT timeout: discovery is periodic best-effort —
+                # at 100-daemon scale a backlogged mon must not hold
+                # one offload thread per OSD for the default 15 s
+                reply = self.monc.command(
+                    {"prefix": "mgr stat"}, timeout=3.0
+                )
                 active = (
                     json.loads(reply.outb).get("active")
                     if reply.rc == 0
@@ -3769,6 +3904,11 @@ class OSD(Dispatcher):
             # fault-plane counters (l_msgr_fault_*) ride the same
             # perf → MMgrReport → prometheus pipe
             dump.update(self.messenger.faults.perf.dump())
+            # shared-stack worker telemetry (l_msgr_worker_*):
+            # process-global like kernel_stats, merged the same way
+            from ..msg.stack import stack_perf_dump
+
+            dump.update(stack_perf_dump())
             # latency histograms (op_hist.<qos>.<type> + the commit
             # distribution): the mgr slo module merges these
             # cluster-wide; the exporter renders native histogram
@@ -4401,13 +4541,18 @@ class OSD(Dispatcher):
             if count == 0 and self._slow_ops_reported == 0:
                 return
             self._slow_ops_last_report = now
+            # bounded like the stat report: this fires exactly when
+            # the cluster is ALREADY slow — the default 15 s timeout
+            # would park one offload thread per complaining OSD on a
+            # backlogged mon
             self.monc.command(
                 {
                     "prefix": "osd slow ops",
                     "daemon": f"osd.{self.whoami}",
                     "count": count,
                     "oldest_age": summary["oldest_age"],
-                }
+                },
+                timeout=3.0,
             )
             # clog the TRANSITIONS (not every refresh), and only
             # AFTER the mon report succeeded — clogging before it

@@ -21,6 +21,7 @@ reference's ENCODE_START/crc scheme, not its exact byte layout.
 
 from __future__ import annotations
 
+import struct
 from dataclasses import dataclass, field
 
 from ..common.encoding import (
@@ -42,6 +43,33 @@ from ..native import ceph_crc32c
 
 CEPH_OSD_DEFAULT_PRIMARY_AFFINITY = 0x10000
 CEPH_OSD_MAX_PRIMARY_AFFINITY = 0x10000
+
+# process-wide raw CRUSH mapping memo (OSDMapMapping role,
+# src/osd/OSDMapMapping.h): keyed on the crush CONTENT fingerprint +
+# the exact do_rule inputs, so every daemon in an in-process cluster
+# shares one pure-Python straw2 descent per (map, PG) instead of
+# re-walking it per daemon — the 100-OSD scale harness turns a
+# minutes-long map walk into one
+_RAW_MAP_CACHE: dict = {}
+_RAW_MAP_CACHE_MAX = 65536
+
+
+def _crush_fp(crush: CrushMap) -> bytes:
+    """Content fingerprint of a CrushMap, memoized against its
+    ``mutation`` counter (bumped by every mutator) — the encode runs
+    once per distinct map content per object, not per mapping.
+    128-bit digest: a 32-bit crc keyed placement for the whole
+    process, where a silent collision would misdirect I/O."""
+    import hashlib
+
+    cached = getattr(crush, "_content_fp", None)
+    if cached is not None and cached[0] == crush.mutation:
+        return cached[1]
+    fp = hashlib.blake2b(
+        encode_crush_map(crush), digest_size=16
+    ).digest()
+    crush._content_fp = (crush.mutation, fp)
+    return fp
 
 # per-OSD state bits (src/include/rados.h:125-132)
 CEPH_OSD_EXISTS = 1 << 0
@@ -257,7 +285,34 @@ class OSDMap:
         ruleno = self.crush.find_rule(pool.crush_rule, pool.type, pool.size)
         raw: list[int] = []
         if ruleno >= 0:
+            # process-wide raw-mapping memo (the OSDMapMapping role,
+            # src/osd/OSDMapMapping.h: the reference precomputes every
+            # PG's mapping per epoch rather than re-walking CRUSH).
+            # In-process clusters hold one OSDMap copy PER DAEMON with
+            # identical contents, so keying on content — crush
+            # fingerprint + the exact do_rule inputs — lets 100
+            # daemons share one descent per PG per epoch instead of
+            # paying the pure-Python straw2 walk 100 times.
+            key = (
+                _crush_fp(self.crush),
+                struct.pack(
+                    f"<{len(self.osd_weight)}I", *self.osd_weight
+                ),
+                bytes(self.osd_exists),
+                ruleno,
+                pps,
+                pool.size,
+                pool.can_shift_osds(),
+            )
+            hit = _RAW_MAP_CACHE.get(key)
+            if hit is not None:
+                return list(hit), pps
             raw = self.crush.do_rule(ruleno, pps, pool.size, self.osd_weight)
+            self._remove_nonexistent(pool, raw)
+            if len(_RAW_MAP_CACHE) >= _RAW_MAP_CACHE_MAX:
+                _RAW_MAP_CACHE.clear()
+            _RAW_MAP_CACHE[key] = tuple(raw)
+            return raw, pps
         self._remove_nonexistent(pool, raw)
         return raw, pps
 

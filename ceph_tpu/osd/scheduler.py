@@ -53,6 +53,11 @@ class _SchedulerBase:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._size = 0
+        # event-driven consumers (the shared-services strand drain)
+        # register here: called AFTER every enqueue/put, outside the
+        # scheduler lock, so a drain can be kicked without a thread
+        # parked in get()
+        self.on_enqueue = None
         # recent dequeue classes (observability: tests prove client
         # ops interleave with a recovery storm from this trace)
         self.class_log: collections.deque = collections.deque(
@@ -78,6 +83,9 @@ class _SchedulerBase:
                 self._enqueue_weighted(klass, max(int(cost), 1), item)
             self._size += 1
             self._cond.notify()
+        cb = self.on_enqueue
+        if cb is not None:
+            cb()
 
     def known_class(self, klass: str) -> bool:
         """True when this scheduler has a registered queue (weight or
@@ -135,6 +143,9 @@ class _SchedulerBase:
             with self._cond:
                 self._draining = True
                 self._cond.notify_all()
+            cb = self.on_enqueue
+            if cb is not None:
+                cb()  # wake an event-driven drain to observe draining
             return
         self.enqueue(CLASS_STRICT, 0, item)
 

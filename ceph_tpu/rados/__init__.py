@@ -107,7 +107,9 @@ class Rados:
         if reply.rc != 0:
             raise RadosError(reply.outs)
         out = json.loads(reply.outb)
-        self.monc.wait_for_epoch(out["epoch"])
+        # generous: on a loaded box the subscription push carrying
+        # the new pool can trail the command reply by many seconds
+        self.monc.wait_for_epoch(out["epoch"], timeout=30.0)
         return out["pool_id"]
 
     def pool_delete(self, name: str) -> None:

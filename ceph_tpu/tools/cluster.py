@@ -107,6 +107,11 @@ class Cluster:
             osd = OSD(
                 i, store=store,
                 admin_socket_path=str(self.dir / f"osd.{i}.asok"),
+                # big clusters ride the shared network stack's
+                # strands/timers instead of 3 threads per daemon
+                shared_services=bool(
+                    self.spec.get("shared_services")
+                ) or None,
             )
             osd.boot(*mon_addr)
             self.osds.append(osd)
@@ -223,6 +228,7 @@ def _cmd_start(args) -> int:
         "memstore": args.memstore,
         "mon_port": args.mon_port,
         "rgw_port": args.rgw_port,
+        "shared_services": args.shared_services,
     }
     if args.daemonize:
         pid = os.fork()
@@ -321,6 +327,11 @@ def main(argv=None) -> int:
     sp.add_argument("--rgw", type=int, default=0)
     sp.add_argument("--memstore", action="store_true",
                     help="RAM stores (no persistence)")
+    sp.add_argument(
+        "--shared-services", action="store_true",
+        help="OSD tick/report/op-queue on the shared network "
+        "stack (zero per-daemon threads; for large --osds)",
+    )
     sp.add_argument("--mon-port", type=int, default=0)
     sp.add_argument("--rgw-port", type=int, default=0)
     sp.add_argument("-d", "--dir", default="./ceph-tpu-cluster")

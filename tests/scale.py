@@ -1,0 +1,495 @@
+"""Scale harness — N-OSD × M-mon in-process clusters on the shared
+network stack (the proof ROADMAP open item 1 asks for: 100 daemons in
+one process, booting, peering, and converging a CRUSH remap under
+client load, with a process thread count independent of daemon
+count).
+
+Every daemon runs with ``shared_services=True``: messengers multiplex
+onto the NetworkStack's event-loop workers, op queues drain through
+offload strands, and tick/report loops ride stack timers — so the
+process's thread bill is workers + a small elastic offload pool + the
+constant mon-quorum threads, whatever N is.
+
+``run_scale(n_osd)`` drives the full scenario and returns a report
+dict (phase timings, SLO verdict, thread accounting, chaos-weather
+results).  pytest runs it at 16 OSDs in tier-1 and 100 OSDs behind
+``slow`` (tests/test_scale.py); ``python tests/scale.py --osds 100``
+runs it standalone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ceph_tpu.crush.builder import CrushMap  # noqa: E402
+from ceph_tpu.crush.types import CRUSH_BUCKET_STRAW2, Tunables  # noqa: E402
+from ceph_tpu.msg.messenger import wait_for  # noqa: E402
+from ceph_tpu.msg.stack import NetworkStack  # noqa: E402
+from ceph_tpu.osd.daemon import OSD  # noqa: E402
+from ceph_tpu.osd.osdmap import OSDMap  # noqa: E402
+from ceph_tpu.rados import Rados, RadosError  # noqa: E402
+
+DEFAULT_SEED = 20260804
+
+
+def _log(msg: str) -> None:
+    print(f"scale: {msg}", file=sys.stderr, flush=True)
+
+# thread-count contract: everything beyond the stack's own threads
+# (workers + elastic offload) must fit a budget that does NOT grow
+# with the OSD count — the 3 quorum mons keep their worker/elector/
+# ticker trios + lazy paxos pools, plus main/pytest/JAX bookkeeping
+DAEMON_INDEPENDENT_BUDGET = 48
+
+
+def build_map(n_osd: int) -> OSDMap:
+    cmap = CrushMap(tunables=Tunables())
+    hosts = []
+    for h in range(n_osd):
+        hosts.append(
+            cmap.add_bucket(
+                CRUSH_BUCKET_STRAW2, 1, [h], [0x10000],
+                name=f"host{h}",
+            )
+        )
+    cmap.add_bucket(
+        CRUSH_BUCKET_STRAW2, 3, hosts,
+        [cmap.buckets[b].weight for b in hosts], name="default",
+    )
+    cmap.add_simple_rule("rep", "default", "host", mode="firstn")
+    return OSDMap.build(cmap, n_osd)
+
+
+class ScaleCluster:
+    """N shared-services OSDs over a 3-mon paxos quorum."""
+
+    def __init__(
+        self,
+        n_osd: int,
+        n_mon: int = 3,
+        tick_interval: float | None = None,
+        heartbeat_grace: float | None = None,
+    ):
+        from test_paxos import MonCluster
+
+        if tick_interval is None:
+            # one CPU core serves every daemon: at 100 OSDs a 1 Hz
+            # tick (heartbeat fan-out + stat reports) would saturate
+            # the box before the workload sends a byte — but the tick
+            # also paces peering retries, so going too slow stretches
+            # the remap tail instead
+            tick_interval = 1.0 if n_osd <= 32 else 4.0
+        if heartbeat_grace is None:
+            # nobody dies in this scenario: a grace that scales with
+            # the cluster keeps GIL-convoy ping latency from turning
+            # into spurious down-marks (each one kills intervals and
+            # stalls writes for tick-paced re-peering rounds)
+            heartbeat_grace = max(20.0, tick_interval * 8, n_osd * 1.2)
+        self.n_osd = n_osd
+        self.mons = MonCluster(n_mon=n_mon, n_osd=n_osd)
+        # MonCluster's base map carries a small default pool; the
+        # harness creates its own, which is fine — the default pool's
+        # PGs peer too and add a little realism
+        self.leader = self.mons.wait_quorum()
+        self.mon_addrs = [
+            self.mons.monmap.addrs[r] for r in sorted(self.mons.mons)
+        ]
+        self.osds: dict[int, OSD] = {}
+        self.tick_interval = tick_interval
+        self.heartbeat_grace = heartbeat_grace
+
+    def start_osd(self, i: int) -> OSD:
+        osd = OSD(
+            i,
+            tick_interval=self.tick_interval,
+            heartbeat_grace=self.heartbeat_grace,
+            shared_services=True,
+            # a multi-OSD-out remap re-replicates many PGs at once:
+            # give the reservation plane more parallelism so the
+            # tick-paced retry queue drains in fewer waves
+            max_backfills=6,
+        )
+        # stat reports and mgr discovery are O(n) mon commands per
+        # interval: stretch them with the cluster so the leader's
+        # workq serves the actual workload (there is no mgr here at
+        # all — discovery would otherwise burn 20 commands/s at 100
+        # OSDs forever)
+        osd.stat_report_interval = max(1.0, self.n_osd / 10.0)
+        osd.mgr_discovery_interval = max(5.0, self.n_osd / 2.0)
+        osd.boot(mon_addrs=self.mon_addrs)
+        self.osds[i] = osd
+        return osd
+
+    def boot_all(self) -> None:
+        for i in range(self.n_osd):
+            self.start_osd(i)
+
+    def kill_osd(self, i: int) -> None:
+        osd = self.osds.pop(i)
+        osd.shutdown()
+
+    def wait_all_up(self, timeout: float) -> bool:
+        return wait_for(
+            lambda: all(
+                self.leader.osdmap.is_up(o) for o in self.osds
+            ),
+            timeout,
+            interval=0.25,  # cheap polls: the core is busy booting
+        )
+
+    def pgs_active(self, pool_id: int, pg_num: int, osdmap) -> bool:
+        for ps in range(pg_num):
+            _u, _up, acting, primary = osdmap.pg_to_up_acting_osds(
+                pool_id, ps
+            )
+            if primary not in self.osds:
+                return False
+            pg = self.osds[primary].pgs.get(f"{pool_id}.{ps}")
+            if (
+                pg is None
+                or pg.state != "active"
+                or pg.peered_interval is None
+            ):
+                return False
+        return True
+
+    def shutdown(self) -> None:
+        for i in list(self.osds):
+            self.kill_osd(i)
+        self.mons.shutdown()
+
+
+def _p(lats: list[float], q: float) -> float | None:
+    if not lats:
+        return None
+    s = sorted(lats)
+    return s[min(len(s) - 1, int(len(s) * q))]
+
+
+def run_scale(
+    n_osd: int = 100,
+    pg_num: int = 64,
+    n_out: int = 5,
+    seed: int = DEFAULT_SEED,
+    storm_p99_bound_ms: float | None = None,
+    with_chaos: bool = True,
+) -> dict:
+    """Boot → peer → load → CRUSH remap under load → (chaos weather)
+    → SLO + thread-count verdicts.  Asserts the acceptance properties
+    and returns the report."""
+    if storm_p99_bound_ms is None:
+        # the whole cluster shares ONE CPU core on this CI box: the
+        # acceptable remap-window tail grows with daemon count, up
+        # to the client's 60 s op timeout — past THAT line writes
+        # fail outright, and zero-client-errors + zero-acked-write-
+        # loss are asserted unconditionally.  The measured p99 rides
+        # the report either way (the regression surface).
+        storm_p99_bound_ms = min(
+            58000.0, max(15000.0, n_osd * 550.0)
+        )
+    report: dict = {"n_osd": n_osd, "pg_num": pg_num, "seed": seed}
+    t0 = time.monotonic()
+    # thread accounting baseline: under the full pytest suite other
+    # modules' stragglers (reaping offload threads, reconnect loops)
+    # are still alive — the contract is about what THIS cluster adds
+    baseline_threads = threading.active_count()
+    c = ScaleCluster(n_osd)
+    client = None
+    stop = threading.Event()
+    threads: list[threading.Thread] = []
+    try:
+        # -- phase 1: boot --------------------------------------------------
+        _log(f"booting {n_osd} OSDs over 3 mons")
+        c.boot_all()
+        assert c.wait_all_up(
+            60.0 + n_osd * 0.5
+        ), "not every OSD came up"
+        report["boot_sec"] = round(time.monotonic() - t0, 1)
+        _log(f"all up in {report['boot_sec']}s")
+
+        # -- phase 2: pool + peering ---------------------------------------
+        t1 = time.monotonic()
+        client = Rados("scale-client").connect_any(c.mon_addrs)
+        client.objecter.op_timeout = 60.0
+        # generous command timeout: the leader's workq is also
+        # serving 100 daemons' boot/subscription traffic
+        reply = client.monc.command(
+            {
+                "prefix": "osd pool create",
+                "pool": "scalepool",
+                "pg_num": pg_num,
+                "size": 3,
+            },
+            timeout=120.0,
+        )
+        assert reply.rc == 0, reply.outs
+        # map propagation to this client rides the subscription and
+        # the boot storm is still settling: wait for the pool epoch
+        # generously (wait_for_epoch's default 10 s is not enough on
+        # a saturated single core)
+        assert wait_for(
+            lambda: "scalepool"
+            in client.monc.osdmap.pool_names.values(),
+            120.0,
+            interval=0.25,
+        ), "pool create never reached the client's map"
+        pool_id = client.pool_lookup("scalepool")
+        assert wait_for(
+            lambda: c.pgs_active(
+                pool_id, pg_num, client.monc.osdmap
+            ),
+            60.0 + n_osd * 0.5,
+            interval=0.25,
+        ), "PGs never peered to active"
+        report["peer_sec"] = round(time.monotonic() - t1, 1)
+        _log(f"{pg_num} PGs active in {report['peer_sec']}s")
+
+        # -- phase 3: client load ------------------------------------------
+        io = client.open_ioctx("scalepool")
+        # settle: pgs_active is a control-plane statement; the boot/
+        # peering storm can still be churning the data plane.  The
+        # SLO baseline window only means something once a probe
+        # write answers promptly several times in a row.
+        settle_deadline = time.monotonic() + 120.0
+        fast = 0
+        while fast < 5 and time.monotonic() < settle_deadline:
+            t = time.monotonic()
+            try:
+                io.write_full("settle", b"s" * 512)
+                fast = (
+                    fast + 1
+                    if time.monotonic() - t < 1.0
+                    else 0
+                )
+            except RadosError:
+                fast = 0
+        _log(f"data plane settled (5 fast probes) fast={fast}")
+        acked: dict[str, bytes] = {}
+        lat_base: list[float] = []
+        lat_storm: list[float] = []
+        errors: list[str] = []
+        remapping = threading.Event()
+        lock = threading.Lock()
+
+        def load(widx: int):
+            i = 0
+            while not stop.is_set():
+                oid = f"w{widx}-{i % 16}"
+                data = bytes([1 + (i + widx) % 255]) * 2048
+                t = time.monotonic()
+                try:
+                    io.write_full(oid, data)
+                    dt = time.monotonic() - t
+                    with lock:
+                        acked[oid] = data
+                        (
+                            lat_storm
+                            if remapping.is_set()
+                            else lat_base
+                        ).append(dt)
+                except RadosError as e:
+                    errors.append(str(e))
+                i += 1
+                time.sleep(0.05 if n_osd <= 32 else 0.15)
+
+        for w in range(2):
+            t = threading.Thread(target=load, args=(w,), daemon=True)
+            t.start()
+            threads.append(t)
+        time.sleep(3.0)  # a real baseline window
+        assert lat_base, "load never completed a baseline write"
+
+        # -- phase 4: steady-state thread accounting -----------------------
+        stack = NetworkStack.live()
+        assert stack is not None
+        peak_offload = stack.offload.peak
+        # the offload pool is elastic: the boot/peering storm grows
+        # it, idle reaping shrinks it back — wait out the reap window
+        # (load is still running, so a steady-state working set of
+        # threads remains) and assert the FLAT count
+        wait_for(
+            lambda: stack.offload.size <= 32, 25.0, interval=0.5
+        )
+        stack_threads = stack.thread_count()
+        total_threads = threading.active_count()
+        report["threads"] = {
+            "total": total_threads,
+            "baseline": baseline_threads,
+            "stack_workers": len(stack.workers),
+            "stack_offload": stack.offload.size,
+            "offload_peak": peak_offload,
+            "budget": DAEMON_INDEPENDENT_BUDGET,
+        }
+        _log(f"threads: {report['threads']}")
+        assert (
+            total_threads
+            <= baseline_threads
+            + stack_threads
+            + DAEMON_INDEPENDENT_BUDGET
+        ), (
+            f"thread count scales with daemons: {total_threads} "
+            f"threads for {n_osd} OSDs (stack={stack_threads}, "
+            f"baseline={baseline_threads})"
+        )
+
+        # -- phase 5: full CRUSH remap under load --------------------------
+        t2 = time.monotonic()
+        remapping.set()
+        out = sorted(c.osds)[-n_out:]
+        for o in out:
+            # a commit can race an election under storm ("no quorum
+            # for commit"): retry like an operator would
+            reply = None
+            for _attempt in range(20):
+                reply = client.monc.command(
+                    {"prefix": "osd out", "id": o}, timeout=120.0
+                )
+                if reply.rc == 0:
+                    break
+                time.sleep(2.0)
+            assert reply is not None and reply.rc == 0, reply.outs
+        report["out"] = out
+
+        def remapped():
+            osdmap = client.monc.osdmap
+            for ps in range(pg_num):
+                _u, _up, acting, primary = (
+                    osdmap.pg_to_up_acting_osds(pool_id, ps)
+                )
+                if any(o in out for o in acting):
+                    return False
+            return c.pgs_active(pool_id, pg_num, osdmap)
+
+        assert wait_for(
+            remapped, 120.0 + n_osd * 1.0, interval=0.25
+        ), "CRUSH remap never converged"
+        report["remap_sec"] = round(time.monotonic() - t2, 1)
+        remapping.clear()
+        _log(f"remap converged in {report['remap_sec']}s")
+
+        # -- phase 6: chaos weather at scale (tests/chaos.py vocab) --------
+        if with_chaos:
+            import chaos as chaos_mod
+
+            # 6a: lossy client->OSD links, seeded — writes land
+            # exactly once and the decision stream is seeded
+            cm = client.messenger
+            cm.faults.reseed(seed)
+            for i, osd in c.osds.items():
+                cm.faults.alias(
+                    f"osd.{i}", chaos_mod.addr_str(osd.addr)
+                )
+            rule = cm.faults.add_rule(
+                delay=0.005, jitter=0.01, dup=0.2
+            )
+            for k in range(16):
+                io.write_full(
+                    f"lossy-{k}", bytes([k + 1]) * 1024
+                )
+                acked[f"lossy-{k}"] = bytes([k + 1]) * 1024
+            weather = cm.faults.perf.dump()
+            assert (
+                weather["fault_delayed"] + weather["fault_duplicated"]
+                > 0
+            ), "chaos weather never touched a frame"
+            cm.faults.clear(rule)
+
+            # 6b: partition two live OSDs from each other (a mini
+            # netsplit inside the big cluster), heal, verify the
+            # plane recovers
+            live = [o for o in sorted(c.osds) if o not in out]
+            a, b = live[0], live[1]
+            msgrs = [c.osds[a].messenger, c.osds[b].messenger]
+            aliases = {
+                f"osd.{o}": chaos_mod.addr_str(c.osds[o].addr)
+                for o in (a, b)
+            }
+            chaos_mod.install_partition(
+                msgrs,
+                [[f"osd.{a}"], [f"osd.{b}"]],
+                aliases,
+                name="scale-split",
+                seed=seed,
+            )
+            time.sleep(2.0)
+            chaos_mod.heal(msgrs, "scale-split")
+            report["chaos"] = {
+                "lossy_delayed": weather["fault_delayed"],
+                "lossy_duplicated": weather["fault_duplicated"],
+                "partitioned": [a, b],
+            }
+
+        # -- phase 7: drain load, verify zero acked-write loss -------------
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert wait_for(
+            lambda: c.pgs_active(
+                pool_id, pg_num, client.monc.osdmap
+            ),
+            60.0,
+            interval=0.25,
+        ), "cluster fell out of active after the weather"
+        for oid, data in sorted(acked.items()):
+            assert io.read(oid) == data, f"acked write {oid} lost"
+        report["acked_writes"] = len(acked)
+        report["client_errors"] = len(errors)
+
+        # -- phase 8: SLO verdict ------------------------------------------
+        base_p99 = _p(lat_base, 0.99)
+        storm_p99 = _p(lat_storm, 0.99)
+        verdict = {
+            "baseline_p99_ms": round((base_p99 or 0.0) * 1000, 1),
+            "remap_p99_ms": round((storm_p99 or 0.0) * 1000, 1),
+            "bound_ms": storm_p99_bound_ms,
+            "held": (
+                storm_p99 is not None
+                and storm_p99 * 1000 <= storm_p99_bound_ms
+            ),
+        }
+        report["slo"] = verdict
+        assert verdict["held"], (
+            f"client p99 lost during the remap: {verdict}"
+        )
+        report["total_sec"] = round(time.monotonic() - t0, 1)
+        return report
+    finally:
+        stop.set()
+        if client is not None:
+            client.shutdown()
+        c.shutdown()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="scale", description=__doc__)
+    p.add_argument("--osds", type=int, default=100)
+    p.add_argument("--pg-num", type=int, default=64)
+    p.add_argument("--out", type=int, default=5)
+    p.add_argument("--seed", type=int, default=DEFAULT_SEED)
+    p.add_argument("--no-chaos", action="store_true")
+    args = p.parse_args(argv)
+    t0 = time.monotonic()
+    report = run_scale(
+        n_osd=args.osds,
+        pg_num=args.pg_num,
+        n_out=args.out,
+        seed=args.seed,
+        with_chaos=not args.no_chaos,
+    )
+    print(
+        f"scale {args.osds}x3: ok in "
+        f"{time.monotonic() - t0:.1f}s {json.dumps(report)}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

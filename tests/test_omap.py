@@ -156,10 +156,11 @@ def cluster():
 @pytest.fixture(scope="module")
 def rados_client(cluster):
     r = Rados("omap-test").connect(*cluster.mon_addr)
-    r.mon_command(
-        {"prefix": "osd pool create", "pool": "omappool",
-         "pg_num": 2, "size": 3}
-    )
+    # pool_create (vs a raw mon_command) waits for the map epoch the
+    # commit produced — command replies resolve ahead of queued map
+    # pushes on the shared stack, exactly like real librados needing
+    # wait_for_latest_osdmap after a pool create
+    r.pool_create("omappool", pg_num=2, size=3)
     try:
         yield r
     finally:

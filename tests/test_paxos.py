@@ -160,12 +160,19 @@ def test_election_and_replicated_commits(cluster):
             ),
             5.0,
         ), "commit did not replicate to all mons"
-        # every mon's store has the same last_committed chain
-        lcs = {
-            r: m.store.last_committed()
-            for r, m in cluster.mons.items()
-        }
-        assert len(set(lcs.values())) == 1, lcs
+        # every mon's store converges on the same last_committed
+        # chain (peons apply COMMIT fan-out asynchronously — on the
+        # shared stack the final apply may trail the map check by a
+        # dispatch beat)
+        def lcs():
+            return {
+                r: m.store.last_committed()
+                for r, m in cluster.mons.items()
+            }
+
+        assert wait_for(
+            lambda: len(set(lcs().values())) == 1, 5.0
+        ), lcs()
     finally:
         client.shutdown()
 

@@ -48,10 +48,14 @@ def test_session_survives_socket_kill_and_replays():
         sc = cli_msgr.connect_session(host, port, "t1")
         r = sc.call(MPing(from_osd=1, stamp=1.0))
         assert isinstance(r, MPing) and r.is_reply
-        # kill the underlying socket from the server side
+        # kill the underlying socket from the server side (hold the
+        # OLD transport: the session proactively redials on reset,
+        # so sc._conn may already be a fresh open connection by the
+        # time we look)
+        old_conn = sc._conn
         for conn in list(srv_msgr._conns):
             conn.close()
-        assert wait_for(lambda: sc._conn.is_closed, 5.0)
+        assert wait_for(lambda: old_conn.is_closed, 5.0)
         # the session transparently reconnects and the call completes
         r = sc.call(MPing(from_osd=1, stamp=2.0))
         assert isinstance(r, MPing) and r.stamp == 2.0

@@ -68,6 +68,22 @@ FAULT_COUNTERS = (
     "fault_dropped", "fault_delayed", "fault_duplicated",
     "fault_socket_failures",
 )
+# shared-stack worker telemetry the stack schema must declare
+# (msg/stack.py build_stack_perf — aggregates plus the per-worker
+# series, all riding stack_perf_dump() → MMgrReport → prometheus)
+WORKER_COUNTERS = (
+    "l_msgr_workers",
+    "l_msgr_worker_connections",
+    "l_msgr_worker_dispatch",
+    "l_msgr_worker_loop_lag",
+    "l_msgr_offload_threads",
+    "l_msgr_offload_threads_peak",
+)
+WORKER_PER_INDEX_COUNTERS = (
+    "l_msgr_worker{i}_connections",
+    "l_msgr_worker{i}_dispatch",
+    "l_msgr_worker{i}_loop_lag",
+)
 # fullness gauges the OSD schema must declare (the osd_stat_t carry
 # feeding OSD_NEARFULL/OSD_FULL and the backoff visibility gauge)
 FULLNESS_COUNTERS = (
@@ -330,6 +346,30 @@ def check_fault_counters() -> list[str]:
         for name in FULLNESS_COUNTERS
         if name not in osd_declared
     )
+    return errors
+
+
+def check_worker_counters() -> list[str]:
+    """The shared-stack plane: build_stack_perf must keep declaring
+    the l_msgr_worker_* family (aggregates + every per-worker index
+    up to the declared worker count) the scale harness and the mgr
+    exporter read."""
+    from ceph_tpu.msg.stack import build_stack_perf
+
+    n = 3
+    declared = set(build_stack_perf(n)._counters)
+    errors = [
+        f"stack schema: worker counter {name!r} missing"
+        for name in WORKER_COUNTERS
+        if name not in declared
+    ]
+    for i in range(n):
+        errors.extend(
+            f"stack schema: per-worker counter "
+            f"{tmpl.format(i=i)!r} missing"
+            for tmpl in WORKER_PER_INDEX_COUNTERS
+            if tmpl.format(i=i) not in declared
+        )
     return errors
 
 
@@ -605,6 +645,7 @@ def product_counter_sets():
     """Every schema the product registers (import side effects force
     lazy groups into existence so the lint sees the real shape)."""
     from ceph_tpu.msg.faults import build_msgr_perf
+    from ceph_tpu.msg.stack import build_stack_perf, default_workers
     from ceph_tpu.ops.kernel_stats import KernelStats
     from ceph_tpu.osd.daemon import build_osd_perf
     from ceph_tpu.osd.mapping import _build_perf as build_mapping_perf
@@ -623,6 +664,7 @@ def product_counter_sets():
     return [
         build_osd_perf(0), build_mapping_perf(), ks.perf,
         build_msgr_perf("osd.0"),
+        build_stack_perf(default_workers()),
     ]
 
 
@@ -649,6 +691,7 @@ def check_all(sets=None) -> list[str]:
         errors.extend(product_scrub_samples())
         errors.extend(check_scrub_counters())
         errors.extend(check_fault_counters())
+        errors.extend(check_worker_counters())
         errors.extend(check_residency_counters())
         errors.extend(check_recovery_counters())
         errors.extend(product_histogram_exposition())
