@@ -1277,6 +1277,218 @@ def measure_msgr() -> dict:
     return {"msgr": curve}
 
 
+def measure_rgw_index() -> dict:
+    """Sharded bucket-index plane (ROADMAP open item 4): index write
+    ops/s and listing p99 on one bucket at 1 vs N shards under
+    concurrent writers, then an ONLINE 1→N reshard under live load —
+    duration plus the client-visible write stall (the worst single
+    put latency across the reshard window), with a zero-lost /
+    zero-phantom verdict.  Entirely CPU-side (omap traffic over the
+    in-process cluster), so a down TPU tunnel cannot eat it."""
+    import pathlib
+    import sys as _sys
+    import threading as _threading
+
+    _sys.path.insert(0, str(pathlib.Path(__file__).parent / "tests"))
+    from test_osd_daemon import MiniCluster
+
+    from ceph_tpu.rados import Rados
+    from ceph_tpu.rgw import RGW
+
+    n_threads = 4
+    n_objs = 480
+    shards_hi = 8
+    c = MiniCluster()
+    r = gw = None
+    try:
+        for i in range(3):
+            c.start_osd(i)
+        c.wait_active()
+        r = Rados("bench-rgw").connect(*c.mon_addr)
+        r.pool_create("rgwbench", pg_num=8, size=2)
+        # threshold checks off: the curve measures the index write
+        # path, not the fill probe
+        gw = RGW(r.open_ioctx("rgwbench"), max_objs_per_shard=0)
+
+        def fill_rate(bucket: str, shards: int) -> tuple[float, float]:
+            """Index-PLANE ops/s: concurrent ``set_entry`` mutations
+            (sharded omap write + layout validation read — exactly
+            the path a PUT's index transaction rides, without the
+            data write/ACL/datalog overhead that buries the shard
+            spread), then listing p99 over paged merged walks of the
+            same index.  NOTE this whole in-process mount shares one
+            GIL, so the shard spread shows up as reduced hot-object
+            serialization, not core scaling — the raw-omap ceiling
+            here is ~1.4x."""
+            gw.create_bucket(bucket, shards=shards)
+            rec = gw._bucket_rec(bucket)
+            ent = {
+                "size": 64, "etag": "0" * 32, "mtime": 0.0,
+                "owner": None, "acl": {"owner": None, "grants": []},
+            }
+
+            def put_range(t: int):
+                for i in range(t, n_objs, n_threads):
+                    gw.index.set_entry(
+                        bucket, f"o{i:05d}", ent, rec=rec
+                    )
+
+            threads = [
+                _threading.Thread(target=put_range, args=(t,))
+                for t in range(n_threads)
+            ]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            ops_per_s = n_objs / (time.perf_counter() - t0)
+            # listing p99 over paged walks of the full bucket
+            pages: list[float] = []
+            for _round in range(3):
+                marker = ""
+                while True:
+                    t0 = time.perf_counter()
+                    entries, trunc = gw.list_objects(
+                        bucket, marker=marker, max_keys=100
+                    )
+                    pages.append(time.perf_counter() - t0)
+                    if not trunc:
+                        break
+                    marker = entries[-1]["key"]
+            s = sorted(pages)
+            p99 = s[min(len(s) - 1, int(len(s) * 0.99))] * 1000
+            return ops_per_s, p99
+
+        # 1 shard vs N shards: the hot single omap object vs the
+        # hash-spread shard set.  Interleaved best-of-trials (the
+        # measure_mesh idiom): single-core CI noise swings one trial
+        # by ±20%, which would randomly invert a one-shot curve
+        ops_1 = ops_n = 0.0
+        list_p99_1 = list_p99_n = float("inf")
+        for trial in range(3):
+            o1, l1 = fill_rate(f"b1_{trial}", 1)
+            on, ln = fill_rate(f"bN_{trial}", shards_hi)
+            ops_1, list_p99_1 = max(ops_1, o1), min(list_p99_1, l1)
+            ops_n, list_p99_n = max(ops_n, on), min(list_p99_n, ln)
+        _log(
+            f"rgw_index: {ops_1:.0f} index ops/s @1 shard → "
+            f"{ops_n:.0f} @{shards_hi} shards ({n_threads} writers, "
+            "best of 3, GIL-shared mount); listing p99 "
+            f"{list_p99_1:.1f} → {list_p99_n:.1f} ms"
+        )
+
+        # online reshard under load: writers keep hammering while
+        # the bucket reshards 1→4; stall = worst put latency seen
+        gw.create_bucket("live")
+        for i in range(240):
+            gw.put_object("live", f"seed{i:04d}", b"y" * 64)
+        stop = _threading.Event()
+        lats: list[float] = []
+        lock = _threading.Lock()
+        oracle: dict[int, dict] = {}
+        errors: list[str] = []
+
+        def hammer(t: int):
+            mine: dict = {}
+            i = 0
+            try:
+                while not stop.is_set():
+                    key = f"w{t}-{i % 40:02d}"
+                    t0 = time.perf_counter()
+                    if i % 6 == 5 and key in mine:
+                        gw.delete_object("live", key)
+                        mine.pop(key)
+                    else:
+                        gw.put_object("live", key, b"z" * 64)
+                        mine[key] = True
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        lats.append(dt)
+                    i += 1
+            except Exception as e:  # noqa: BLE001 — verdict below
+                errors.append(f"{type(e).__name__}: {e}")
+            oracle[t] = mine
+
+        threads = [
+            _threading.Thread(target=hammer, args=(t,), daemon=True)
+            for t in range(n_threads)
+        ]
+        for th in threads:
+            th.start()
+        time.sleep(0.5)
+        st = gw.bucket_reshard("live", 4)
+        time.sleep(0.5)
+        stop.set()
+        for th in threads:
+            th.join(timeout=30)
+        expect = {f"seed{i:04d}" for i in range(240)}
+        for mine in oracle.values():
+            expect.update(mine)
+        listed, marker = set(), ""
+        while True:
+            entries, trunc = gw.list_objects(
+                "live", marker=marker, max_keys=500
+            )
+            listed.update(e["key"] for e in entries)
+            if not trunc:
+                break
+            marker = entries[-1]["key"]
+        stall_ms = max(lats) * 1000 if lats else 0.0
+        _log(
+            f"rgw_reshard: 1→4 shards in {st['duration_s']}s over "
+            f"{st['entries']} entries, worst client write stall "
+            f"{stall_ms:.0f}ms, lost={len(expect - listed)} "
+            f"phantom={len(listed - expect)} errors={len(errors)}"
+        )
+        out = {
+            "rgw_index": {
+                "writers": n_threads,
+                "objects": n_objs,
+                "curve": [
+                    {
+                        "shards": 1,
+                        "ops_per_s": round(ops_1, 1),
+                        "list_p99_ms": round(list_p99_1, 2),
+                    },
+                    {
+                        "shards": shards_hi,
+                        "ops_per_s": round(ops_n, 1),
+                        "list_p99_ms": round(list_p99_n, 2),
+                    },
+                ],
+                "reshard": {
+                    "from_shards": 1,
+                    "to_shards": 4,
+                    "entries": st["entries"],
+                    "passes": st["passes"],
+                    "duration_s": st["duration_s"],
+                    "stall_ms": round(stall_ms, 1),
+                    "ops_during": len(lats),
+                    "lost": len(expect - listed),
+                    "phantom": len(listed - expect),
+                    "writer_errors": errors,
+                },
+            },
+            # flat regression surfaces (the BENCH_r* trajectory keys)
+            "rgw_index_ops_per_s": {
+                "1": round(ops_1, 1),
+                str(shards_hi): round(ops_n, 1),
+            },
+            "rgw_reshard_stall_ms": round(stall_ms, 1),
+        }
+        return out
+    finally:
+        # teardown on EVERY path: a section failure must not leak
+        # the gateway workers / client connections into the bench
+        # sections that follow
+        if gw is not None:
+            gw.shutdown()
+        if r is not None:
+            r.shutdown()
+        c.shutdown()
+
+
 def measure_recovery(on_tpu: bool) -> dict:
     """Recovery-storm plane (ROADMAP open item 2): decode-from-
     survivors rebuild throughput before/after the coalesced batched
@@ -1776,6 +1988,15 @@ def main(argv=None) -> None:
 
             traceback.print_exc()
             out["msgr_error"] = f"{type(e).__name__}: {e}"
+        # sharded bucket-index curve + reshard-under-load verdict:
+        # CPU-side like msgr — always attempted, never eats the line
+        try:
+            out.update(measure_rgw_index())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            traceback.print_exc()
+            out["rgw_index_error"] = f"{type(e).__name__}: {e}"
         if be != "none":
             # families BEFORE the big crush compiles: the remote
             # compile service degrades late in a long session, and

@@ -168,6 +168,27 @@ SCHEMA: dict[str, Option] = {
             level=LEVEL_BASIC,
         ),
         Option(
+            "rgw_max_objs_per_shard",
+            OPT_INT,
+            100000,
+            "bucket-index entries per shard before the bucket joins "
+            "the dynamic-reshard queue (rgw_max_objs_per_shard, "
+            "options.cc)",
+            min=1,
+            level=LEVEL_BASIC,
+        ),
+        Option(
+            "osd_deep_scrub_large_omap_object_key_threshold",
+            OPT_INT,
+            200000,
+            "omap keys on one object before deep scrub flags it "
+            "LARGE_OMAP_OBJECTS "
+            "(osd_deep_scrub_large_omap_object_key_threshold, "
+            "options.cc)",
+            min=1,
+            level=LEVEL_BASIC,
+        ),
+        Option(
             "perf_enabled",
             OPT_BOOL,
             True,

@@ -315,10 +315,13 @@ class Monitor(Dispatcher):
         # module ("crash report") — raises RECENT_CRASH
         self.recent_crashes = 0
         # scrub-error reports ("osd scrub errors" upcalls): daemon ->
-        # (wallclock received, error count, damaged pgids).  Feeds
-        # OSD_SCRUB_ERRORS / PG_DAMAGED; a zero report clears, stale
+        # (wallclock received, error count, damaged pgids, large-omap
+        # object count).  Feeds OSD_SCRUB_ERRORS / PG_DAMAGED /
+        # LARGE_OMAP_OBJECTS; an all-zero report clears, stale
         # reports age out like slow-op reports
-        self.scrub_reports: dict[str, tuple[float, int, list]] = {}
+        self.scrub_reports: dict[
+            str, tuple[float, int, list, int]
+        ] = {}
         # per-OSD space stats ("osd stat report" upcalls, the
         # osd_stat_t role): osd -> (wallclock received, kb, kb_used,
         # kb_avail).  Feeds OSD_NEARFULL / OSD_FULL
@@ -520,8 +523,8 @@ class Monitor(Dispatcher):
         # reference keeps it in pg stats).  Only a reporter that left
         # the cluster drops its contribution (its PGs re-scrub under
         # their new primaries).
-        err_total, damaged = 0, set()
-        for daemon, (_ts, count, pgs) in list(
+        err_total, damaged, large_total = 0, set(), 0
+        for daemon, (_ts, count, pgs, large) in list(
             self.scrub_reports.items()
         ):
             try:
@@ -534,6 +537,7 @@ class Monitor(Dispatcher):
             if count > 0:
                 err_total += count
                 damaged.update(pgs)
+            large_total += max(0, large)
         if err_total:
             checks["OSD_SCRUB_ERRORS"] = {
                 "severity": "HEALTH_ERR",
@@ -545,6 +549,18 @@ class Monitor(Dispatcher):
                 "summary": (
                     f"Possible data damage: {len(damaged)} pg"
                     f"{'s' if len(damaged) > 1 else ''} inconsistent"
+                ),
+            }
+        if large_total:
+            # LARGE_OMAP_OBJECTS (PGMap::get_health_checks): deep
+            # scrub found omap objects past the key threshold — the
+            # bucket-index reshard signal; cleared by the next deep
+            # scrub after the index re-shards
+            checks["LARGE_OMAP_OBJECTS"] = {
+                "severity": "HEALTH_WARN",
+                "summary": (
+                    f"{large_total} large omap object"
+                    f"{'s' if large_total > 1 else ''} found"
                 ),
             }
         if self.recent_crashes:
@@ -1371,10 +1387,13 @@ def _cmd_osd_scrub_errors(mon: Monitor, cmd: dict) -> MMonCommandReply:
         return MMonCommandReply(rc=-22, outs="missing daemon")
     errors = int(cmd.get("errors", 0))
     pgs = [str(p) for p in cmd.get("pgs", [])]
-    if errors <= 0:
+    large = int(cmd.get("large_omap", 0))
+    if errors <= 0 and large <= 0:
         mon.scrub_reports.pop(daemon, None)
     else:
-        mon.scrub_reports[daemon] = (time.time(), errors, pgs)
+        mon.scrub_reports[daemon] = (
+            time.time(), errors, pgs, large,
+        )
     return MMonCommandReply(rc=0, outb=json.dumps({"ok": True}))
 
 

@@ -254,6 +254,10 @@ class PG:
         self.last_scrub = 0.0
         self.last_deep_scrub = 0.0
         self.scrub_errors: list[dict] = []
+        # deep-scrub omap-cardinality findings (LARGE_OMAP_OBJECTS):
+        # object names whose omap key count crossed the threshold at
+        # the last deep scrub; only a deep scrub re-judges them
+        self.large_omap: list[str] = []
 
 
 @dataclass

@@ -130,6 +130,9 @@ def build_scrub_map(
             ent: dict = {
                 "exists": True,
                 "size": store.stat(cid, oid),
+                # omap cardinality feeds the LARGE_OMAP_OBJECTS
+                # deep-scrub check (the bucket-index hot-spot signal)
+                "omap_keys": len(omap),
                 "omap_digest": _digest(omap),
                 "attrs_digest": _digest(
                     {
@@ -437,7 +440,7 @@ class _Run:
 
     __slots__ = (
         "pgid", "deep", "repair", "epoch", "acting", "oids", "idx",
-        "records", "reserved", "started",
+        "records", "large_omap", "reserved", "started",
     )
 
     def __init__(self, pgid, deep, repair, epoch, acting):
@@ -449,6 +452,7 @@ class _Run:
         self.oids: list[str] = []
         self.idx = 0
         self.records: list[dict] = []
+        self.large_omap: list[str] = []
         self.reserved: list[int] = []
         self.started = time.monotonic()
 
@@ -847,6 +851,16 @@ class Scrubber:
         maps_by_osd = self._gather_maps(pg, run, oids, run.deep)
         osd.perf.inc("scrub_chunks")
         if run.deep:
+            # LARGE_OMAP_OBJECTS: the primary's own digest map
+            # carries each object's omap cardinality (replicas hold
+            # the same keys; one authoritative count suffices)
+            thr = self._large_omap_threshold()
+            own = maps_by_osd.get(osd.whoami) or {}
+            for oid in oids:
+                ent = own.get(oid) or {}
+                if ent.get("omap_keys", 0) > thr:
+                    run.large_omap.append(self._strip(oid))
+        if run.deep:
             osd.perf.inc(
                 "scrub_deep_bytes",
                 sum(
@@ -1129,6 +1143,15 @@ class Scrubber:
         pg.last_scrub = now
         if run.deep:
             pg.last_deep_scrub = now
+            # only a deep pass re-judges omap cardinality (a shallow
+            # one never counted keys and must not clear the finding)
+            pg.large_omap = list(run.large_omap)
+            if run.large_omap:
+                osd.clog.warn(
+                    f"pg {pg.pgid} {what} found "
+                    f"{len(run.large_omap)} large omap object(s): "
+                    f"{sorted(run.large_omap)[:4]}"
+                )
         try:
             ScrubStore.save(osd.store, pg.cid, run.records)
         except StoreError:
@@ -1179,7 +1202,7 @@ class Scrubber:
             return
         current = self._current_report()
         if current != self._last_reported or (
-            current[0] > 0
+            (current[0] > 0 or current[2] > 0)
             and now - self._last_report_stamp > 30.0
         ):
             # nonzero findings RE-ASSERT periodically: the mon drops
@@ -1187,6 +1210,16 @@ class Scrubber:
             # re-assert a recovered OSD whose state never changed
             # would leave known damage invisible in ceph health
             self.report_health()
+
+    def _large_omap_threshold(self) -> int:
+        try:
+            return int(
+                self.osd.config.get(
+                    "osd_deep_scrub_large_omap_object_key_threshold"
+                )
+            )
+        except (KeyError, TypeError, ValueError):
+            return 200000
 
     def _current_report(self) -> tuple:
         osd = self.osd
@@ -1203,14 +1236,19 @@ class Scrubber:
                 for pg in osd.pgs.values()
                 if pg.primary == osd.whoami
             )
-        return errors, damaged
+            large = sum(
+                len(pg.large_omap)
+                for pg in osd.pgs.values()
+                if pg.primary == osd.whoami
+            )
+        return errors, damaged, large
 
     def report_health(self) -> None:
         """Tell the mon how many scrub errors this OSD's primary PGs
         carry (feeds OSD_SCRUB_ERRORS / PG_DAMAGED; a zero report
         clears)."""
         osd = self.osd
-        errors, damaged = self._current_report()
+        errors, damaged, large = self._current_report()
         osd.perf.set("scrub_errors", errors)
         self._last_report_stamp = time.monotonic()
         try:
@@ -1220,9 +1258,12 @@ class Scrubber:
                     "daemon": f"osd.{osd.whoami}",
                     "errors": errors,
                     "pgs": list(damaged),
+                    # omap-cardinality findings ride the same upcall
+                    # (LARGE_OMAP_OBJECTS)
+                    "large_omap": large,
                 },
                 timeout=5.0,
             )
-            self._last_reported = (errors, damaged)
+            self._last_reported = (errors, damaged, large)
         except (MessageError, OSError):
             pass

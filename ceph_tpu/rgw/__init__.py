@@ -80,6 +80,12 @@ from .lifecycle import LCWorker, apply_rules
 
 __all__ = ["RGW", "RGWError", "AccessDenied", "sign_request"]
 
+
+def _default_max_objs_per_shard() -> int:
+    from ..common.config import SCHEMA
+
+    return int(SCHEMA["rgw_max_objs_per_shard"].default)
+
 BUCKETS_DIR = "rgw.buckets"
 USERS_OID = "rgw.users"
 LC_OID = "rgw.lc"  # lifecycle configs: bucket -> rules (lc shard role)
@@ -177,10 +183,6 @@ class RGWError(Exception):
     pass
 
 
-def _index_oid(bucket: str) -> str:
-    return f"bucket.index.{bucket}"
-
-
 def _data_oid(bucket: str, key: str) -> str:
     return f"rgw.obj.{bucket}/{key}"
 
@@ -200,13 +202,37 @@ class AccessDenied(RGWError):
 class RGW:
     """The gateway daemon: storage logic + embedded HTTP frontend."""
 
-    def __init__(self, ioctx, auth: bool = False):
+    def __init__(
+        self,
+        ioctx,
+        auth: bool = False,
+        bucket_index_shards: int = 1,
+        max_objs_per_shard: int | None = None,
+        name: str = "rgw",
+    ):
+        from .index import BucketIndex, build_rgw_perf
+
         self.io = ioctx
         self.server = None
         self.port = 0
         self.auth = auth
+        self.name = name
         self.lc_worker = None
         self.lc_debug = False
+        # sharded bucket-index plane (index.py): every index
+        # read/write/list below rides it; new buckets default to
+        # this many shards (1 = the legacy single-omap layout)
+        self.bucket_index_shards = int(bucket_index_shards)
+        self.max_objs_per_shard = (
+            _default_max_objs_per_shard()
+            if max_objs_per_shard is None
+            else int(max_objs_per_shard)
+        )
+        self.perf = build_rgw_perf("rgw")
+        self.index = BucketIndex(self)
+        self.reshard_worker = None
+        self._mgr_stop = None
+        self._mgr_thread = None
         # set by _verify per call: was the last verified identity a
         # temporary (STS) credential?  Read immediately by the STS
         # route to refuse self-renewal (handler threads each verify
@@ -398,16 +424,11 @@ class RGW:
 
     # -- ACL plumbing (rgw_acl.cc verify_permission seat) ------------------
     @staticmethod
-    def _index_oid(bucket: str) -> str:
-        return _index_oid(bucket)
-
-    @staticmethod
     def _parse_bucket_rec(raw: bytes) -> dict:
+        from .index import decode_bucket_record
+
         try:
-            rec = json.loads(raw)
-            if not isinstance(rec, dict):
-                raise ValueError
-            return rec
+            return decode_bucket_record(raw)
         except ValueError:
             # legacy record (bare ctime string): system-owned
             return {"ctime": raw.decode(), "owner": None,
@@ -420,8 +441,10 @@ class RGW:
         return self._parse_bucket_rec(raw)
 
     def _save_bucket_rec(self, bucket: str, rec: dict) -> None:
+        from .index import encode_bucket_record
+
         self.io.omap_set(
-            BUCKETS_DIR, {bucket: json.dumps(rec).encode()}
+            BUCKETS_DIR, {bucket: encode_bucket_record(rec)}
         )
 
     def _require(
@@ -477,15 +500,13 @@ class RGW:
         self, bucket: str, key: str, canned: str, user=SYSTEM
     ) -> None:
         rec = self._bucket_rec(bucket)
-        entry = self.stat_object(bucket, key)
+        entry = self.stat_object(bucket, key, rec=rec)
         self._require(
             user, aclmod.WRITE_ACP, entry.get("acl"),
             rec.get("owner"), f"{bucket}/{key}",
         )
         entry["acl"] = aclmod.make_acl(entry.get("owner"), canned)
-        self.io.omap_set(
-            _index_oid(bucket), {key: json.dumps(entry).encode()}
-        )
+        self.index.set_entry(bucket, key, entry, rec=rec)
         self._log_change("acl", bucket, key, user)
 
     def get_object_acl(self, bucket: str, key: str, user=SYSTEM) -> dict:
@@ -614,7 +635,11 @@ class RGW:
             return {}
 
     def create_bucket(
-        self, bucket: str, user=SYSTEM, canned: str = "private"
+        self,
+        bucket: str,
+        user=SYSTEM,
+        canned: str = "private",
+        shards: int | None = None,
     ) -> None:
         if user is None:
             # S3: bucket creation always needs an authenticated
@@ -625,13 +650,17 @@ class RGW:
         if bucket in self._buckets():
             raise RGWError(f"bucket {bucket!r} exists")
         owner = None if user == SYSTEM else user
-        self.io.write_full(_index_oid(bucket), b"")
+        idx = self.index.create(
+            bucket,
+            self.bucket_index_shards if shards is None else shards,
+        )
         self._save_bucket_rec(
             bucket,
             {
                 "ctime": time.time(),
                 "owner": owner,
                 "acl": aclmod.make_acl(owner, canned),
+                "index": idx,
             },
         )
         self._log_change("create_bucket", bucket, None, user)
@@ -641,9 +670,16 @@ class RGW:
         # DeleteBucket is OWNER-only (S3/RGW): a public-read-write
         # WRITE grant covers objects, never the bucket itself
         self._require_owner(user, rec, bucket)
-        if self.io.omap_get_vals(_index_oid(bucket), max_return=1):
+        if self.index.layout(bucket, rec).resharding():
+            # deleting mid-reshard would race the migrator's record
+            # reads and the cutover cleanup (the reference refuses
+            # this too)
+            raise RGWError(f"bucket {bucket!r} is resharding")
+        # emptiness must consult EVERY shard of the current
+        # generation — one empty shard proves nothing
+        if self.index.any_entries(bucket, rec=rec):
             raise RGWError(f"bucket {bucket!r} not empty")
-        self.io.remove(_index_oid(bucket))
+        self.index.remove_index(bucket, rec=rec)
         self.io.omap_rm_keys(BUCKETS_DIR, [bucket])
         self.io.omap_rm_keys(LC_OID, [bucket])
         self._log_change("delete_bucket", bucket, None, user)
@@ -667,19 +703,17 @@ class RGW:
         owner = None if user in (SYSTEM, None) else user
         # the index entry commits AFTER the data (the reference's
         # prepare/complete index transaction, collapsed)
-        self.io.omap_set(
-            _index_oid(bucket),
+        self.index.set_entry(
+            bucket,
+            key,
             {
-                key: json.dumps(
-                    {
-                        "size": len(data),
-                        "etag": etag,
-                        "mtime": time.time(),
-                        "owner": owner,
-                        "acl": aclmod.make_acl(owner, canned),
-                    }
-                ).encode()
+                "size": len(data),
+                "etag": etag,
+                "mtime": time.time(),
+                "owner": owner,
+                "acl": aclmod.make_acl(owner, canned),
             },
+            rec=rec,
         )
         self._log_change("put", bucket, key, user)
         return etag
@@ -710,11 +744,15 @@ class RGW:
             raise RGWError(f"{bucket}/{key}: torn object")
         return data
 
-    def stat_object(self, bucket: str, key: str) -> dict:
-        vals = self.io.omap_get_vals(_index_oid(bucket))
-        if key not in vals:
+    def stat_object(
+        self, bucket: str, key: str, rec: dict | None = None
+    ) -> dict:
+        """Entry lookup via ONE index shard (no longer a full-index
+        read — stat cost is independent of bucket size)."""
+        raw = self.index.get_entry(bucket, key, rec=rec)
+        if raw is None:
             raise ObjectNotFound(f"{bucket}/{key}")
-        return json.loads(vals[key])
+        return json.loads(raw)
 
     def delete_object(self, bucket: str, key: str, user=SYSTEM) -> None:
         rec = self._bucket_rec(bucket)
@@ -722,9 +760,9 @@ class RGW:
             user, aclmod.WRITE, rec.get("acl"), rec.get("owner"),
             bucket,
         )
-        self.stat_object(bucket, key)
+        self.stat_object(bucket, key, rec=rec)
         self._drop_object_data(bucket, key)
-        self.io.omap_rm_keys(_index_oid(bucket), [key])
+        self.index.rm_entry(bucket, key, rec=rec)
         self._log_change("delete", bucket, key, user)
 
     # -- lifecycle (rgw_lc.cc reduced; see lifecycle.py) -------------------
@@ -817,9 +855,7 @@ class RGW:
         entry["data_oid"] = cold_oid
         entry["storage_class"] = storage_class
         entry["compression"] = "zlib"
-        self.io.omap_set(
-            _index_oid(bucket), {key: json.dumps(entry).encode()}
-        )
+        self.index.set_entry(bucket, key, entry)
         self._log_change("transition", bucket, key, None)
         for oid in old_oids:
             if oid == cold_oid:
@@ -934,23 +970,21 @@ class RGW:
         )
         self._drop_object_data(bucket, key)  # overwrite semantics
         owner = None if user in (SYSTEM, None) else user
-        self.io.omap_set(
-            _index_oid(bucket),
+        self.index.set_entry(
+            bucket,
+            key,
             {
-                key: json.dumps(
-                    {
-                        "size": sum(m["size"] for _n, m in parts),
-                        "etag": etag,
-                        "mtime": time.time(),
-                        "owner": owner,
-                        "acl": aclmod.make_acl(owner),
-                        "parts": [
-                            _part_oid(bucket, key, upload_id, n)
-                            for n, _m in parts
-                        ],
-                    }
-                ).encode()
+                "size": sum(m["size"] for _n, m in parts),
+                "etag": etag,
+                "mtime": time.time(),
+                "owner": owner,
+                "acl": aclmod.make_acl(owner),
+                "parts": [
+                    _part_oid(bucket, key, upload_id, n)
+                    for n, _m in parts
+                ],
             },
+            rec=rec,
         )
         self.io.omap_rm_keys(
             _mp_oid(bucket),
@@ -1009,24 +1043,121 @@ class RGW:
         user=SYSTEM,
     ) -> tuple[list[dict], bool]:
         """Key-ordered page after ``marker`` → (entries, truncated):
-        one omap page read, the bucket-index listing."""
+        k-way merge-sorted across the bucket's index shards —
+        byte-identical to the single-omap listing (see index.py)."""
         rec = self._bucket_rec(bucket)
         self._require(
             user, aclmod.READ, rec.get("acl"), rec.get("owner"),
             bucket,
         )
-        vals = self.io.omap_get_vals(
-            _index_oid(bucket), start_after=marker,
-            max_return=max_keys + 1,
+        page, truncated = self.index.list_page(
+            bucket, marker=marker, max_keys=max_keys, rec=rec
         )
-        keys = sorted(vals)
-        truncated = len(keys) > max_keys
         out = []
-        for k in keys[:max_keys]:
-            entry = json.loads(vals[k])
+        for k, raw in page:
+            entry = json.loads(raw)
             entry["key"] = k
             out.append(entry)
         return out, truncated
+
+    # -- reshard admin (radosgw-admin bucket reshard roles) ----------------
+    def bucket_reshard(
+        self, bucket: str, num_shards: int, user=SYSTEM
+    ) -> dict:
+        """``bucket reshard --num-shards N``: online reshard, owner/
+        system only (an index relayout is an administrative act)."""
+        rec = self._bucket_rec(bucket)
+        self._require_owner(user, rec, bucket)
+        return self.index.reshard(bucket, num_shards)
+
+    def reshard_status(self, bucket: str, user=SYSTEM) -> dict:
+        """``reshard status --bucket B``."""
+        rec = self._bucket_rec(bucket)
+        self._require_owner(user, rec, bucket)
+        return self.index.status(bucket)
+
+    def reshard_list(self, user=SYSTEM) -> list[dict]:
+        """``reshard list``: the pending reshard queue."""
+        if user not in (SYSTEM, SYNC_USER):
+            raise AccessDenied("reshard list is admin-only")
+        return self.index.reshard_queue()
+
+    def reshard_process(self, user=SYSTEM) -> int:
+        """``reshard process``: drain the queue now."""
+        if user not in (SYSTEM, SYNC_USER):
+            raise AccessDenied("reshard process is admin-only")
+        return self.index.process_reshard_queue()
+
+    def start_reshard(self, interval: float = 2.0) -> None:
+        """Run the background reshard worker (RGWReshard's
+        processor thread)."""
+        from .index import ReshardWorker
+
+        if self.reshard_worker is None:
+            self.reshard_worker = ReshardWorker(self, interval)
+
+    # -- mgr telemetry (perf → MMgrReport → prometheus) --------------------
+    def _mgr_report_once(self, state: dict) -> None:
+        """One best-effort perf push: discover the active mgr
+        through the mon (cached, slow cadence) and send an
+        MMgrReport — the exact pipe every daemon's counters ride."""
+        from ..msg.message import MMgrReport
+
+        rados = self.io.rados
+        now = time.monotonic()
+        if state.get("addr") is None and (
+            now - state.get("checked", -1e9) < 5.0
+        ):
+            return
+        try:
+            if (
+                state.get("addr") is None
+                or now - state.get("checked", -1e9) > 5.0
+            ):
+                state["checked"] = now
+                rc, outb, _outs = rados.mon_command(
+                    {"prefix": "mgr stat"}
+                )
+                active = (
+                    json.loads(outb).get("active") if rc == 0 else None
+                )
+                addr = active["addr"] if active else None
+                if addr != state.get("addr"):
+                    state["addr"] = addr
+                    state["conn"] = None
+            if state.get("addr") is None:
+                return
+            conn = state.get("conn")
+            if conn is None or conn.is_closed:
+                host, _, port = state["addr"].rpartition(":")
+                conn = state["conn"] = rados.messenger.connect(
+                    host, int(port), timeout=5.0
+                )
+            conn.send(
+                MMgrReport(
+                    daemon=self.name,
+                    perf=json.dumps(self.perf.dump()),
+                )
+            )
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            state["conn"] = None
+
+    def start_mgr_reports(self, interval: float = 1.0) -> None:
+        """Push ``l_rgw_index_*``/``l_rgw_reshard_*`` to the mgr on
+        a timer, like an OSD's stats plane."""
+        if self._mgr_thread is not None:
+            return
+        self._mgr_stop = threading.Event()
+        state: dict = {}
+
+        def loop():
+            while not self._mgr_stop.wait(interval):
+                self._mgr_report_once(state)
+
+        self._mgr_thread = threading.Thread(
+            target=loop, name=f"{self.name}.mgrreport", daemon=True
+        )
+        self._mgr_thread.start()
 
     # -- HTTP frontend (the beast role) ------------------------------------
     def serve(self, port: int = 0) -> int:
@@ -1465,5 +1596,13 @@ class RGW:
         if self.lc_worker is not None:
             self.lc_worker.stop()
             self.lc_worker = None
+        if self.reshard_worker is not None:
+            self.reshard_worker.stop()
+            self.reshard_worker = None
+        if self._mgr_stop is not None:
+            self._mgr_stop.set()
+            self._mgr_thread.join(timeout=5)
+            self._mgr_stop = None
+            self._mgr_thread = None
         if self.server is not None:
             self.server.shutdown()

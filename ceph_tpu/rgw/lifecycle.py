@@ -41,7 +41,9 @@ def apply_rules(rgw, bucket: str, rules: list[dict], debug: bool) -> dict:
     now = time.time()
     stats = {"expired": 0, "transitioned": 0}
     try:
-        index = rgw.io.omap_get_vals(rgw._index_oid(bucket))
+        # snapshot the merged sharded listing up front: the loop
+        # below mutates the index it walks
+        index = dict(rgw.index.entries(bucket))
     except Exception:  # noqa: BLE001 — bucket vanished mid-pass
         return stats
     for key, raw in index.items():

@@ -26,7 +26,6 @@ pass is idempotent re-copy."""
 
 from __future__ import annotations
 
-import json
 import threading
 import time
 
@@ -112,13 +111,32 @@ class SyncAgent:
                     break
 
     def _ensure_bucket(self, bucket: str) -> None:
-        rec = self.src._bucket_rec(bucket)
+        rec = dict(self.src._bucket_rec(bucket))
         try:
             self.dst._bucket_rec(bucket)
         except RGWError:
             self.dst.create_bucket(bucket, user=SYNC_USER)
-        # owner/acl + lifecycle follow the source (metadata sync)
-        self.dst._save_bucket_rec(bucket, rec)
+        # owner/acl + lifecycle follow the source (metadata sync).
+        # NOT the index layout: each zone shards and reshards its
+        # indexes independently (adopting the source's descriptor
+        # would point the replica at shard objects it never wrote —
+        # every previously synced entry would vanish from listings).
+        # The read-modify-write runs under the destination's bucket
+        # lock with the destination record re-read inside it, and
+        # keeps the destination's OWN index + live reshard
+        # descriptor verbatim: an unlocked save racing the reshard
+        # state machine could erase a cutover mark (losing a
+        # concurrent write's redo signal) or revert a freshly
+        # flipped generation
+        with self.dst._bucket_lock(bucket):
+            drec = self.dst._bucket_rec(bucket)
+            rec["index"] = drec.get("index") or {
+                "gen": 0, "num_shards": 1,
+            }
+            rec.pop("reshard", None)
+            if "reshard" in drec:
+                rec["reshard"] = drec["reshard"]
+            self.dst._save_bucket_rec(bucket, rec)
         rules = self.src.get_bucket_lifecycle(bucket, user=SYSTEM)
         if rules:
             self.dst.put_bucket_lifecycle(bucket, rules, user=SYNC_USER)
@@ -146,10 +164,9 @@ class SyncAgent:
         for k in ("owner", "acl"):
             if k in entry:
                 dentry[k] = entry[k]
-        self.dst.io.omap_set(
-            self.dst._index_oid(bucket),
-            {key: json.dumps(dentry).encode()},
-        )
+        # through the index layer (the destination bucket may be
+        # sharded — or mid-reshard — independently of the source)
+        self.dst.index.set_entry(bucket, key, dentry)
 
     def _apply(self, ent: dict) -> None:
         op, bucket, key = ent["op"], ent["bucket"], ent.get("key")

@@ -155,10 +155,16 @@ class Cluster:
             self.rgw = RGW(
                 r.open_ioctx("rgwpool"),
                 auth=bool(self.spec.get("rgw_auth", False)),
+                name="rgw.0",
             )
             conf["rgw_port"] = self.rgw.serve(
                 int(self.spec.get("rgw_port", 0))
             )
+            # production posture: the dynamic-reshard worker drains
+            # the threshold queue, and index/reshard counters flow
+            # to the mgr like every other daemon's
+            self.rgw.start_reshard()
+            self.rgw.start_mgr_reports()
         # atomic publish: the daemonize parent polls for this file
         # and reads it immediately — a partial write would crash it
         tmp = self.dir / "cluster.json.tmp"

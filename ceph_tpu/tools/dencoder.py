@@ -276,6 +276,53 @@ def _build_types():
         lambda: grid_sample().encode(),
         lambda blob: PerfHistogram2D.decode(blob).encode(),
     )
+
+    # sharded bucket-index plane (rgw/index.py): the bucket metadata
+    # record (index layout + live reshard descriptor) and the
+    # reshard-queue entry pin their canonical encodings — a record
+    # shape drift would strand every bucket written before it
+    from ..rgw.index import (
+        decode_bucket_record,
+        decode_reshard_entry,
+        encode_bucket_record,
+        encode_reshard_entry,
+    )
+
+    bucket_rec = {
+        "ctime": 1700000000.0,
+        "owner": "alice",
+        "acl": {
+            "owner": "alice",
+            "grants": [
+                {"grantee": "alice", "permission": "FULL_CONTROL"}
+            ],
+        },
+        "index": {"gen": 2, "num_shards": 8},
+        "reshard": {
+            "status": "in_progress",
+            "target_gen": 3,
+            "target_shards": 16,
+            "stamp": 1700000001.5,
+        },
+    }
+    types["rgw_bucket_record"] = (
+        lambda: encode_bucket_record(bucket_rec),
+        lambda blob: encode_bucket_record(
+            decode_bucket_record(blob)
+        ),
+    )
+    reshard_ent = {
+        "bucket": "photos",
+        "target_shards": 16,
+        "reason": "threshold",
+        "queued_at": 1700000002.25,
+    }
+    types["rgw_reshard_entry"] = (
+        lambda: encode_reshard_entry(reshard_ent),
+        lambda blob: encode_reshard_entry(
+            decode_reshard_entry(blob)
+        ),
+    )
     return types
 
 

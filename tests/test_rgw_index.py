@@ -1,0 +1,591 @@
+"""Sharded bucket-index plane (ceph_tpu/rgw/index.py — the cls_rgw
+sharded index + RGWReshard roles) over the live mini-cluster.
+
+The proofs: sharded listings are byte-identical to the unsharded
+oracle (paged, marker/max-keys edges, multiple omap pages per
+shard); an ONLINE 1→4 reshard under a concurrent PUT/DELETE storm
+loses zero acked entries and lists zero phantoms while the multisite
+datalog stays exactly the client ops (migration is invisible to
+replication); a crash mid-reshard leaves the old generation
+authoritative and the reshard restartable; deep scrub raises
+LARGE_OMAP_OBJECTS on a fat single-shard index and a reshard clears
+it; delete_bucket's emptiness probe consults every shard; the
+``l_rgw_index_*`` counters flow perf → MMgrReport → prometheus."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from ceph_tpu.msg.messenger import wait_for
+from ceph_tpu.osdc.objecter import ObjectNotFound
+from ceph_tpu.rados import Rados
+from ceph_tpu.rgw import RGW, RGWError, SYNC_USER, SYSTEM
+from ceph_tpu.rgw.index import (
+    decode_bucket_record,
+    decode_reshard_entry,
+    encode_bucket_record,
+    encode_reshard_entry,
+    shard_of,
+    shard_oid,
+)
+
+from test_osd_daemon import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster()
+    for i in range(3):
+        c.start_osd(i)
+    c.wait_active()
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    r = Rados("rgw-index-test").connect(*cluster.mon_addr)
+    for pool in ("idxu", "idxs", "idxload", "idxoracle", "idxbig"):
+        r.pool_create(pool, pg_num=2, size=2)
+    try:
+        yield r
+    finally:
+        r.shutdown()
+
+
+def _http(method, url, body=None):
+    req = urllib.request.Request(url, data=body, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:  # pragma: no cover — debug aid
+        return e.code, e.read(), dict(e.headers)
+
+
+def _keys(gw, bucket, **kw):
+    try:
+        entries, _trunc = gw.list_objects(bucket, **kw)
+    except RGWError:
+        return []  # bucket not replicated yet
+    return [e["key"] for e in entries]
+
+
+def _full_listing(gw, bucket, max_keys=1000):
+    out, marker = [], ""
+    while True:
+        entries, trunc = gw.list_objects(
+            bucket, marker=marker, max_keys=max_keys
+        )
+        out.extend(entries)
+        if not trunc:
+            return out
+        marker = entries[-1]["key"]
+
+
+# -- pure units --------------------------------------------------------------
+def test_shard_hash_and_oid_layout():
+    # stable, spread, and in-range
+    assert shard_of("cat.jpg", 4) == shard_of("cat.jpg", 4)
+    hits = {shard_of(f"key-{i:04d}", 4) for i in range(200)}
+    assert hits == {0, 1, 2, 3}, "crc32 sharding never spread"
+    assert shard_of("anything", 1) == 0
+    # the (gen 0, 1 shard) layout keeps the legacy single-object oid
+    assert shard_oid("b", 0, 0, 1) == "bucket.index.b"
+    assert shard_oid("b", 0, 2, 4) == "bucket.index.b.0.2"
+    assert shard_oid("b", 3, 1, 8) == "bucket.index.b.3.1"
+
+
+def test_record_encodings_canonical():
+    rec = {
+        "owner": "o", "ctime": 1.5,
+        "index": {"num_shards": 4, "gen": 1},
+        "reshard": {
+            "status": "in_progress", "target_gen": 2,
+            "target_shards": 8, "stamp": 2.0,
+        },
+    }
+    blob = encode_bucket_record(rec)
+    assert encode_bucket_record(decode_bucket_record(blob)) == blob
+    ent = {"bucket": "b", "target_shards": 8, "reason": "threshold",
+           "queued_at": 3.25}
+    blob = encode_reshard_entry(ent)
+    assert encode_reshard_entry(decode_reshard_entry(blob)) == blob
+
+
+# -- sharded vs unsharded listing identity -----------------------------------
+def test_sharded_listing_identical_to_unsharded_oracle(client):
+    """Same bucket name, same contents — one gateway unsharded, one
+    4-sharded: every HTTP listing page is byte-identical, across
+    marker/max-keys edges and multiple omap pages per shard."""
+    gw_u = RGW(client.open_ioctx("idxu"))
+    gw_s = RGW(client.open_ioctx("idxs"), bucket_index_shards=4)
+    port_u, port_s = gw_u.serve(), gw_s.serve()
+    try:
+        gw_u.create_bucket("b")
+        gw_s.create_bucket("b")
+        assert gw_s._bucket_rec("b")["index"]["num_shards"] == 4
+        # varied keys: mixed prefixes so lexicographic order differs
+        # from insertion order and every shard holds several keys
+        keys = (
+            [f"img/{i:03d}.jpg" for i in range(23)]
+            + [f"log.{i}" for i in range(17)]
+            + ["a", "zz/tail", "m-mid", "img/", "img0"]
+        )
+        for i, k in enumerate(keys):
+            body = f"payload-{i}".encode() * (i % 3 + 1)
+            for gw in (gw_u, gw_s):
+                gw.put_object("b", k, body)
+        # the sharded bucket really is sharded: >1 shard object holds
+        # entries, and the legacy single oid does not exist
+        io_s = client.open_ioctx("idxs")
+        filled = [
+            s for s in range(4)
+            if io_s.omap_get_vals(shard_oid("b", 0, s, 4))
+        ]
+        assert len(filled) > 1, "all keys landed in one shard"
+        with pytest.raises(ObjectNotFound):
+            io_s.stat("bucket.index.b")
+
+        def page(port, query):
+            code, body, _h = _http(
+                "GET", f"http://127.0.0.1:{port}/b{query}"
+            )
+            assert code == 200
+            return body
+
+        # full listing + tight pages (max-keys=2 forces several omap
+        # pulls per shard) + mid-stream markers + past-end marker
+        queries = ["", "?max-keys=1", "?max-keys=2", "?max-keys=7",
+                   "?max-keys=100", "?marker=img/011.jpg&max-keys=3",
+                   "?marker=log.9&max-keys=50", "?marker=zz/tail",
+                   "?marker=a&max-keys=1"]
+        for q in queries:
+            assert page(port_u, q) == page(port_s, q), f"query {q!r}"
+        # full page-walk with a 2-key window is identical end to end
+        # (modulo mtime: the two buckets were filled seconds apart)
+        def norm(entries):
+            return [
+                {k: v for k, v in e.items() if k != "mtime"}
+                for e in entries
+            ]
+
+        walk_u = _full_listing(gw_u, "b", max_keys=2)
+        walk_s = _full_listing(gw_s, "b", max_keys=2)
+        assert norm(walk_u) == norm(walk_s)
+        assert [e["key"] for e in walk_s] == sorted(keys)
+    finally:
+        gw_u.shutdown()
+        gw_s.shutdown()
+
+
+def test_stat_delete_and_emptiness_across_shards(client):
+    """stat reads ONE shard; delete_bucket's emptiness probe sees an
+    object in ANY shard (the single-index assumption fixed)."""
+    io = client.open_ioctx("idxs")
+    gw = RGW(io, bucket_index_shards=4)
+    gw.create_bucket("probe")
+    # place one object per occupied shard; pick a key that does NOT
+    # live in shard 0 so a shard-0-only probe would miss it
+    key = next(
+        f"k{i}" for i in range(64) if shard_of(f"k{i}", 4) != 0
+    )
+    gw.put_object("probe", key, b"x")
+    assert gw.stat_object("probe", key)["size"] == 1
+    with pytest.raises(RGWError, match="not empty"):
+        gw.delete_bucket("probe")
+    gw.delete_object("probe", key)
+    gw.delete_bucket("probe")
+    # every shard object was removed with the bucket
+    for s in range(4):
+        with pytest.raises(ObjectNotFound):
+            io.stat(shard_oid("probe", 0, s, 4))
+
+
+# -- online reshard ----------------------------------------------------------
+def test_reshard_quiet_bucket_and_datalog_silence(client):
+    """1→4 reshard of a quiet bucket: listing unchanged, stat served
+    from the new generation, old shard objects gone, and the
+    DATALOG GAINED NOTHING (migration must be invisible to
+    multisite)."""
+    io = client.open_ioctx("idxu")
+    gw = RGW(io)
+    gw.create_bucket("quiet")
+    for i in range(40):
+        gw.put_object("quiet", f"o{i:03d}", f"v{i}".encode())
+    before = _full_listing(gw, "quiet")
+    head = gw.datalog_head()
+    st = gw.bucket_reshard("quiet", 4)
+    assert st["from_shards"] == 1 and st["to_shards"] == 4
+    assert gw.datalog_head() == head, "reshard re-emitted datalog"
+    assert gw.reshard_status("quiet")["status"] == "idle"
+    assert gw.reshard_status("quiet")["num_shards"] == 4
+    assert _full_listing(gw, "quiet") == before
+    assert gw.stat_object("quiet", "o007")["size"] == 2
+    with pytest.raises(ObjectNotFound):
+        io.stat("bucket.index.quiet")  # old generation cleaned up
+    assert gw.get_object("quiet", "o011") == b"v11"
+    # reshard back down also works (4 -> 2)
+    st = gw.bucket_reshard("quiet", 2)
+    assert st["to_shards"] == 2 and _full_listing(gw, "quiet") == before
+
+
+def test_reshard_under_live_put_delete_storm(client):
+    """THE acceptance test: 1→4 reshard while a concurrent
+    PUT/DELETE mix runs — zero lost acked entries, zero phantom
+    keys, datalog exactly the client ops, and the final sharded
+    listing byte-identical to an unsharded oracle bucket."""
+    gw = RGW(client.open_ioctx("idxload"))
+    gw.create_bucket("hot")
+    prefill = {f"pre{i:03d}": f"seed{i}".encode() for i in range(60)}
+    for k, v in prefill.items():
+        gw.put_object("hot", k, v)
+
+    n_writers = 3
+    stop = threading.Event()
+    oracles: list[dict] = [dict() for _ in range(n_writers)]
+    acked_ops = [0] * n_writers
+    failures: list[str] = []
+
+    def writer(t: int):
+        mine = oracles[t]
+        i = 0
+        try:
+            while not stop.is_set():
+                key = f"w{t}-{i % 25:02d}"
+                if i % 5 == 4 and key in mine:
+                    gw.delete_object("hot", key)
+                    del mine[key]
+                else:
+                    val = f"{t}:{i}".encode()
+                    gw.put_object("hot", key, val)
+                    mine[key] = val
+                acked_ops[t] += 1
+                i += 1
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            failures.append(f"writer {t}: {type(e).__name__}: {e}")
+
+    threads = [
+        threading.Thread(target=writer, args=(t,), daemon=True)
+        for t in range(n_writers)
+    ]
+    for th in threads:
+        th.start()
+    # let traffic flow BEFORE, run the reshard DURING, keep going
+    # AFTER the cutover
+    wait_for(lambda: sum(acked_ops) > 30, 20.0)
+    st = gw.bucket_reshard("hot", 4)
+    assert st["to_shards"] == 4
+    post_cut = sum(acked_ops)
+    wait_for(lambda: sum(acked_ops) > post_cut + 15, 20.0)
+    stop.set()
+    for th in threads:
+        th.join(timeout=30)
+    assert not failures, failures
+    # the two waits above guarantee real traffic before AND after
+    # the cutover (>30 pre, >15 post)
+    assert sum(acked_ops) >= 45
+
+    expect = dict(prefill)
+    for mine in oracles:
+        expect.update(mine)
+    listing = _full_listing(gw, "hot")
+    got_keys = [e["key"] for e in listing]
+    assert sorted(got_keys) == got_keys
+    missing = set(expect) - set(got_keys)
+    phantoms = set(got_keys) - set(expect)
+    assert not missing, f"acked entries lost: {sorted(missing)[:5]}"
+    assert not phantoms, f"phantom keys: {sorted(phantoms)[:5]}"
+    for k, v in expect.items():
+        assert gw.get_object("hot", k) == v, f"{k} bytes diverged"
+    # datalog carries EXACTLY the client ops (create + prefill +
+    # every acked put/delete) — migration re-emitted nothing
+    assert gw.datalog_head() == 1 + len(prefill) + sum(acked_ops)
+    # byte-identical XML vs an unsharded oracle holding the final
+    # state under the same bucket name
+    oracle = RGW(client.open_ioctx("idxoracle"))
+    port_o, port_h = oracle.serve(), gw.serve()
+    try:
+        oracle.create_bucket("hot")
+        for k, v in expect.items():
+            oracle.put_object("hot", k, v)
+        for q in ("", "?max-keys=7", "?marker=pre030&max-keys=11"):
+            _c, body_o, _h = _http(
+                "GET", f"http://127.0.0.1:{port_o}/hot{q}"
+            )
+            _c, body_h, _h = _http(
+                "GET", f"http://127.0.0.1:{port_h}/hot{q}"
+            )
+            assert body_o == body_h, f"XML diverged on {q!r}"
+    finally:
+        oracle.shutdown()
+        gw.shutdown()
+
+
+def test_crash_mid_reshard_recovers(client, monkeypatch):
+    """A resharder dying at every stage leaves the bucket
+    serviceable (old generation authoritative, writes land, reads
+    exact) and the reshard RESUMES to completion."""
+    from ceph_tpu.rgw import index as index_mod
+
+    # a crashed cutover must not park writers for the real grace
+    monkeypatch.setattr(index_mod, "CUTOVER_GRACE", 0.2)
+    gw = RGW(client.open_ioctx("idxu"))
+    gw.create_bucket("frail")
+    data = {f"f{i:02d}": f"d{i}".encode() for i in range(30)}
+    for k, v in data.items():
+        gw.put_object("frail", k, v)
+
+    for stage in ("marked", "migrated", "cutover"):
+        def boom(s, stage=stage):
+            if s == stage:
+                raise RuntimeError(f"crash at {stage}")
+
+        with pytest.raises(RuntimeError, match=stage):
+            gw.index.reshard("frail", 4, fault_hook=boom)
+        st = gw.reshard_status("frail")
+        assert st["status"] in ("in_progress", "cutover")
+        # old generation still authoritative: listing + stat exact
+        assert {
+            e["key"] for e in _full_listing(gw, "frail")
+        } == set(data)
+        # live traffic keeps landing mid-crash (dual-write or the
+        # stale-cutover fallback)
+        gw.put_object("frail", f"new-{stage}", b"alive")
+        data[f"new-{stage}"] = b"alive"
+        gw.delete_object("frail", "f00") if "f00" in data else None
+        data.pop("f00", None)
+        # restart: the reshard resumes and completes
+        st = gw.bucket_reshard("frail", 4)
+        assert st["to_shards"] == 4
+        assert gw.reshard_status("frail")["status"] == "idle"
+        assert {
+            e["key"] for e in _full_listing(gw, "frail")
+        } == set(data)
+        for k, v in data.items():
+            assert gw.get_object("frail", k) == v
+        # arm the next round from the new baseline (gen bumped)
+        gw.index.reshard("frail", 1)
+
+
+def test_superseded_resharder_aborts(client):
+    """A resharder whose layout moved underneath it (a second
+    resharder completed first) must ABORT, not keep migrating
+    against a generation it no longer owns — a stale pass would
+    read the flipped-away gen as empty and delete every entry."""
+    gw = RGW(client.open_ioctx("idxu"))
+    gw.create_bucket("race")
+    data = {f"r{i:02d}": b"v" for i in range(20)}
+    for k in data:
+        gw.put_object("race", k, data[k])
+
+    def boom(stage):
+        if stage == "marked":
+            raise RuntimeError("crash at marked")
+
+    with pytest.raises(RuntimeError):
+        gw.index.reshard("race", 4, fault_hook=boom)
+
+    def finish_elsewhere(stage):
+        # the instant the slow resharder finishes marking, a second
+        # resharder (resuming the same in_progress state) runs the
+        # whole reshard to completion
+        if stage == "marked":
+            gw.index.reshard("race", 4)
+
+    with pytest.raises(RGWError, match="superseded"):
+        gw.index.reshard("race", 4, fault_hook=finish_elsewhere)
+    st = gw.reshard_status("race")
+    assert st["status"] == "idle" and st["num_shards"] == 4
+    assert {e["key"] for e in _full_listing(gw, "race")} == set(data)
+
+
+def test_threshold_queue_and_worker(client):
+    """The reshard queue: per-shard fill past rgw_max_objs_per_shard
+    queues the bucket; processing the queue reshards it and the
+    queue drains."""
+    gw = RGW(
+        client.open_ioctx("idxu"),
+        max_objs_per_shard=8,
+    )
+    gw.index.check_interval = 4  # check fill every 4th mutation
+    gw.create_bucket("fat")
+    for i in range(40):
+        gw.put_object("fat", f"fat{i:03d}", b"x")
+    queue = gw.reshard_list()
+    assert any(e["bucket"] == "fat" for e in queue), queue
+    ent = next(e for e in queue if e["bucket"] == "fat")
+    assert ent["target_shards"] >= 2 and ent["reason"] == "threshold"
+    assert gw.reshard_status("fat")["queued"]
+    before = _full_listing(gw, "fat")
+    assert gw.reshard_process() >= 1
+    st = gw.reshard_status("fat")
+    assert st["num_shards"] == ent["target_shards"]
+    assert not st["queued"]
+    assert _full_listing(gw, "fat") == before
+    assert gw.perf.dump()["l_rgw_reshard_completed"] >= 1
+
+
+def test_replication_continues_across_reshard(client, cluster):
+    """Multisite rides a reshard: the sync agent tails the source
+    datalog while the source bucket reshards — the replica converges
+    on the exact post-reshard state and sees no migration noise."""
+    from ceph_tpu.rgw.multisite import SyncAgent
+
+    r = Rados("rgw-idx-ms").connect(*cluster.mon_addr)
+    r.pool_create("idxza", pg_num=2, size=2)
+    r.pool_create("idxzb", pg_num=2, size=2)
+    a = RGW(r.open_ioctx("idxza"))
+    b = RGW(r.open_ioctx("idxzb"))
+    agent = None
+    try:
+        a.create_bucket("mirror")
+        for i in range(30):
+            a.put_object("mirror", f"m{i:02d}", f"v{i}".encode())
+        agent = SyncAgent(a, b, zone="zidx", interval=0.1)
+        assert wait_for(
+            lambda: len(_keys(b, "mirror")) == 30, 30.0
+        ), "bootstrap never converged"
+        a.bucket_reshard("mirror", 4)
+        a.put_object("mirror", "post-reshard", b"fresh")
+        a.delete_object("mirror", "m03")
+        expect = {f"m{i:02d}" for i in range(30)} - {"m03"}
+        expect.add("post-reshard")
+        # FULL convergence: the source reshard must not blind the
+        # replica to its existing entries (the index layout is
+        # zone-local — a record sync that adopted the source's
+        # descriptor would vanish every previously synced key)
+        assert wait_for(
+            lambda: set(_keys(b, "mirror")) == expect, 30.0
+        ), (
+            "replica diverged across the reshard: "
+            f"{sorted(set(_keys(b, 'mirror')) ^ expect)[:6]}"
+        )
+        assert b.get_object("mirror", "post-reshard") == b"fresh"
+        assert b.get_object("mirror", "m07") == b"v7"
+        # convergence is stable: neither datalog keeps growing
+        ha, hb = a.datalog_head(), b.datalog_head()
+        agent.sync_once()
+        assert (a.datalog_head(), b.datalog_head()) == (ha, hb)
+    finally:
+        if agent is not None:
+            agent.stop()
+        a.shutdown()
+        b.shutdown()
+        r.shutdown()
+
+
+# -- LARGE_OMAP_OBJECTS health loop ------------------------------------------
+def _health(client):
+    rc, outb, outs = client.mon_command({"prefix": "health"})
+    assert rc == 0, outs
+    return json.loads(outb)
+
+
+def test_large_omap_raise_reshard_clear(client, cluster):
+    """The operator loop: a fat single-shard index trips
+    LARGE_OMAP_OBJECTS at deep scrub, a reshard spreads it, the next
+    deep scrub clears the warning."""
+    for osd in cluster.osds.values():
+        osd.config.set(
+            "osd_deep_scrub_large_omap_object_key_threshold", 20
+        )
+    gw = RGW(client.open_ioctx("idxbig"))
+    gw.create_bucket("big")
+    # SYNC_USER writes skip the datalog: the index shards must be
+    # the ONLY omap objects in this pool past the threshold
+    for i in range(70):
+        gw.put_object("big", f"big{i:03d}", b"x", user=SYNC_USER)
+    pool_id = client.pool_lookup("idxbig")
+    pgids = [
+        f"{pool_id}.{ps}"
+        for ps in range(client.monc.osdmap.pools[pool_id].pg_num)
+    ]
+
+    def deep_scrub_all():
+        for pgid in pgids:
+            client.pg_scrub(pgid, deep=True)
+
+    deep_scrub_all()
+    assert wait_for(
+        lambda: "LARGE_OMAP_OBJECTS" in _health(client)[
+            "checks_detail"
+        ],
+        30.0,
+    ), "deep scrub never flagged the fat index"
+    detail = _health(client)["checks_detail"]["LARGE_OMAP_OBJECTS"]
+    assert detail["severity"] == "HEALTH_WARN"
+    # the operator response: reshard (70 entries / 8 shards < 20)
+    gw.bucket_reshard("big", 8)
+    deep_scrub_all()
+    assert wait_for(
+        lambda: "LARGE_OMAP_OBJECTS" not in _health(client)[
+            "checks_detail"
+        ],
+        30.0,
+    ), "reshard + deep scrub never cleared the warning"
+
+
+def test_radosgw_admin_cli(client, cluster, capsys):
+    """The radosgw-admin surface: bucket stats / bucket reshard /
+    reshard status round-trip through the CLI grammar."""
+    from ceph_tpu.tools import rgw_admin
+
+    gw = RGW(client.open_ioctx("idxu"))
+    gw.create_bucket("clib")
+    for i in range(12):
+        gw.put_object("clib", f"c{i}", b"x")
+    mon = "%s:%d" % cluster.mon_addr
+    base = ["-m", mon, "-p", "idxu"]
+
+    def run(*words):
+        assert rgw_admin.main(base + list(words)) == 0
+        return json.loads(capsys.readouterr().out)
+
+    st = run("bucket", "stats", "--bucket", "clib")
+    assert st["num_shards"] == 1 and st["entries"] == 12
+    assert st["shard_fill"] == [12]
+    out = run("bucket", "reshard", "--bucket", "clib",
+              "--num-shards", "4")
+    assert out["to_shards"] == 4
+    st = run("reshard", "status", "--bucket", "clib")
+    assert st["num_shards"] == 4 and st["status"] == "idle"
+    assert run("reshard", "list") == []
+    # unknown bucket is a clean rc=1, not a traceback
+    assert rgw_admin.main(
+        base + ["reshard", "status", "--bucket", "nope"]
+    ) == 1
+
+
+# -- telemetry ---------------------------------------------------------------
+def test_counters_flow_to_mgr_and_prometheus(client, cluster):
+    from ceph_tpu.mgr import Manager, PrometheusModule
+
+    gw = RGW(client.open_ioctx("idxu"), name="rgw.0")
+    gw.create_bucket("meter")
+    gw.put_object("meter", "k", b"v")
+    assert gw.perf.dump()["l_rgw_index_ops"] >= 1
+    mgr = Manager(modules=[PrometheusModule])
+    mgr.start(cluster.mon_addr)
+    try:
+        gw.start_mgr_reports(interval=0.2)
+        assert wait_for(
+            lambda: "rgw.0" in (mgr.get("daemon_perf") or {}), 20.0
+        ), "RGW perf dump never reached the mgr"
+        dump = mgr.get("daemon_perf")["rgw.0"]
+        assert dump["l_rgw_index_ops"] >= 1
+        assert "l_rgw_reshard_completed" in dump
+        port = mgr.modules["prometheus"].port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "ceph_daemon_l_rgw_index_ops" in body
+        assert 'ceph_daemon="rgw.0"' in body
+    finally:
+        gw.shutdown()
+        mgr.shutdown()

@@ -66,12 +66,12 @@ def test_zone_sync_bootstrap_and_incremental(zones):
 
     agent = SyncAgent(a, b, zone="zb", interval=0.2)
     try:
-        # bootstrap: everything (data + acl + lifecycle) appears at b
-        _wait(
-            lambda: b.get_object("photos", "p1.jpg", user=SYSTEM)
-            == b"jpeg-one",
-            msg="bootstrap",
-        )
+        # bootstrap: wait for the COMPLETION signal (full_syncs),
+        # not the first copied object — p2/lifecycle/marker land
+        # after p1, so keying the wait on p1 raced the tail of the
+        # full sync under load (the long-standing bootstrap flake)
+        _wait(lambda: agent.full_syncs >= 1, msg="bootstrap")
+        assert b.get_object("photos", "p1.jpg", user=SYSTEM) == b"jpeg-one"
         assert b.get_object("photos", "p2.jpg", user=SYSTEM) == b"jpeg-two"
         assert b._bucket_rec("photos")["owner"] == "alice"
         # the public-read bucket ACL traveled: anonymous listing works

@@ -103,6 +103,25 @@ RESIDENCY_COUNTERS = (
     "l_tpu_batch_decode_dispatches",
     "l_tpu_batch_decode_ops_per_dispatch",
 )
+# sharded bucket-index + reshard families the RGW schema must
+# declare (rgw/index.py build_rgw_perf — the bench rgw_index section
+# and the reshard-under-load tests read exactly these)
+RGW_INDEX_COUNTERS = (
+    "l_rgw_index_ops",
+    "l_rgw_index_reads",
+    "l_rgw_index_list_pages",
+    "l_rgw_index_list_entries",
+    "l_rgw_index_retries",
+    "l_rgw_index_dual_writes",
+    "l_rgw_index_stall_waits",
+    "l_rgw_index_shards",
+    "l_rgw_reshard_queued",
+    "l_rgw_reshard_started",
+    "l_rgw_reshard_completed",
+    "l_rgw_reshard_entries_migrated",
+    "l_rgw_reshard_passes",
+    "l_rgw_reshard_in_progress",
+)
 # recovery-storm counters the OSD schema must declare (the
 # l_osd_recovery_* block: batched decode rebuild progress + the
 # survivor-read fan-in the LRC locality claim is measured from)
@@ -387,6 +406,20 @@ def check_recovery_counters() -> list[str]:
     ]
 
 
+def check_rgw_counters() -> list[str]:
+    """The sharded-index plane: the gateway schema's
+    ``l_rgw_index_*`` / ``l_rgw_reshard_*`` families, through the
+    REAL builder."""
+    from ceph_tpu.rgw.index import build_rgw_perf
+
+    declared = set(build_rgw_perf("rgw")._counters)
+    return [
+        f"rgw schema: index counter {name!r} missing"
+        for name in RGW_INDEX_COUNTERS
+        if name not in declared
+    ]
+
+
 def check_residency_counters() -> list[str]:
     """The kernel-stats schema must keep declaring the residency and
     batched-encode families through the REAL registration helper
@@ -649,6 +682,7 @@ def product_counter_sets():
     from ceph_tpu.ops.kernel_stats import KernelStats
     from ceph_tpu.osd.daemon import build_osd_perf
     from ceph_tpu.osd.mapping import _build_perf as build_mapping_perf
+    from ceph_tpu.rgw.index import build_rgw_perf
 
     from ceph_tpu.ops.residency import ensure_counters
 
@@ -665,6 +699,7 @@ def product_counter_sets():
         build_osd_perf(0), build_mapping_perf(), ks.perf,
         build_msgr_perf("osd.0"),
         build_stack_perf(default_workers()),
+        build_rgw_perf("rgw"),
     ]
 
 
@@ -694,6 +729,7 @@ def check_all(sets=None) -> list[str]:
         errors.extend(check_worker_counters())
         errors.extend(check_residency_counters())
         errors.extend(check_recovery_counters())
+        errors.extend(check_rgw_counters())
         errors.extend(product_histogram_exposition())
     return errors
 
