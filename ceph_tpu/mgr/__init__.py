@@ -43,7 +43,12 @@ from ..common import tracing
 from ..common.log_client import LogClient
 from ..mon.monitor import MonClient
 from ..msg import Messenger
-from ..msg.message import MMgrReport, MMonCommand, MMonCommandReply
+from ..msg.message import (
+    MMgrReport,
+    MMonCommand,
+    MMonCommandReply,
+    MPGStats,
+)
 from ..msg.messenger import Dispatcher
 
 __all__ = ["Manager", "MgrModule"]
@@ -142,6 +147,8 @@ class Manager(Dispatcher):
                 TracingModule,
                 CrashModule,
                 SLOModule,
+                PgMapModule,
+                ProgressModule,
             ]
         )
         self.modules: dict[str, MgrModule] = {}
@@ -157,6 +164,14 @@ class Manager(Dispatcher):
         # crash inbox: reports piggybacked on MMgrReport, drained by
         # the crash module's tick (bounded the same way)
         self._crash_inbox: deque[dict] = deque(maxlen=256)
+        # PG-stats plane (MPGStats ingestion): osd id -> (ts, epoch,
+        # [pg stat dicts]); the pgmap module folds the freshest
+        # primary reports into the digest
+        self.pg_stats: dict[int, tuple[float, int, list]] = {}
+        self._pg_stats_lock = threading.Lock()
+        # progress events piggybacked on MPGStats (scrub/repair),
+        # drained by the progress module's tick
+        self._progress_inbox: deque[dict] = deque(maxlen=512)
         # the mgr's own cluster-log channel (flushed on the tick)
         self._log_client = LogClient(f"mgr.{name}")
         self.clog = self._log_client.channel()
@@ -182,6 +197,24 @@ class Manager(Dispatcher):
             threading.Thread(
                 target=run, name="mgr.command", daemon=True
             ).start()
+            return True
+        if isinstance(msg, MPGStats):
+            try:
+                stats = json.loads(msg.stats)
+                events = json.loads(msg.events)
+            except ValueError:
+                return True
+            if isinstance(stats, list):
+                with self._pg_stats_lock:
+                    self.pg_stats[msg.osd] = (
+                        time.time(),
+                        msg.epoch,
+                        [s for s in stats if isinstance(s, dict)],
+                    )
+            if isinstance(events, list):
+                self._progress_inbox.extend(
+                    e for e in events if isinstance(e, dict)
+                )
             return True
         if not isinstance(msg, MMgrReport):
             return False
@@ -340,6 +373,29 @@ class Manager(Dispatcher):
                 return {
                     d: dump for d, (_ts, dump) in self.daemon_perf.items()
                 }
+        if what == "pg_stats":
+            # merged primary view: pgid -> freshest stat dict across
+            # reporting OSDs (freshest by (reported_epoch, recv ts));
+            # silence past the grace drops an OSD's contribution, so
+            # a dead primary's stale rows age out like daemon_perf
+            cutoff = time.time() - 30.0
+            merged: dict[str, tuple[tuple, dict]] = {}
+            with self._pg_stats_lock:
+                for osd in [
+                    o for o, (ts, _e, _s) in self.pg_stats.items()
+                    if ts < cutoff
+                ]:
+                    del self.pg_stats[osd]
+                for _osd, (ts, _epoch, stats) in self.pg_stats.items():
+                    for st in stats:
+                        pgid = st.get("pgid")
+                        if not isinstance(pgid, str):
+                            continue
+                        rank = (st.get("reported_epoch", 0), ts)
+                        cur = merged.get(pgid)
+                        if cur is None or rank > cur[0]:
+                            merged[pgid] = (rank, st)
+            return {pgid: st for pgid, (_r, st) in merged.items()}
         if what == "df":
             return {
                 "pools": [
@@ -737,6 +793,21 @@ class PrometheusModule(MgrModule):
                 "cluster log entries by channel and priority",
                 labels={"channel": channel, "prio": prio},
                 kind="counter",
+            )
+        # -- PG-stats plane: pgmap digest families + progress events -------
+        from .pgmap import pgmap_exposition_lines
+
+        pgmap_mod = self.mgr.modules.get("pgmap")
+        digest = getattr(pgmap_mod, "digest", None)
+        if digest:
+            out.extend(pgmap_exposition_lines(digest))
+        progress_mod = self.mgr.modules.get("progress")
+        if progress_mod is not None:
+            events = progress_mod.active_events()
+            metric(
+                "ceph_progress_events",
+                sum(1 for e in events if not e["done"]),
+                "open (not yet completed) mgr progress events",
             )
         return "\n".join(out) + "\n"
 
@@ -1387,5 +1458,7 @@ class PgAutoscalerModule(MgrModule):
 # imported last: slo.py subclasses MgrModule from this module (the
 # bottom import breaks the would-be cycle)
 from .slo import SLOModule  # noqa: E402
+from .pgmap import PgMapModule  # noqa: E402
+from .progress import ProgressModule  # noqa: E402
 
-__all__.append("SLOModule")
+__all__.extend(["SLOModule", "PgMapModule", "ProgressModule"])

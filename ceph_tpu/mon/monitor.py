@@ -148,6 +148,10 @@ class LogStore:
     def __init__(self, store: MonitorStore, max_entries: int = 500):
         self.store = store
         self.max_entries = max_entries
+        # optional fanout hook: called with the ACCEPTED (coerced)
+        # entries after every add — the `ceph -w` watch stream taps
+        # here so subscribers see exactly what the window recorded
+        self.notify = None
         self._entries: deque[dict] = deque(maxlen=max_entries)
         self._totals: dict[str, int] = {}  # "channel/prio" -> count
         self.total = 0
@@ -168,6 +172,7 @@ class LogStore:
 
     def add(self, entries: list[dict]) -> int:
         added = 0
+        accepted: list[dict] = []
         for raw in entries:
             if not isinstance(raw, dict) or "message" not in raw:
                 continue
@@ -201,6 +206,7 @@ class LogStore:
             if not _CHANNEL_RE.match(entry["channel"]):
                 entry["channel"] = "cluster"
             self._entries.append(entry)
+            accepted.append(entry)
             key = f"{entry['channel']}/{entry['prio']}"
             if (
                 key not in self._totals
@@ -216,6 +222,11 @@ class LogStore:
         if added and now - self._last_persist >= 1.0:
             self._last_persist = now
             self._persist()
+        if accepted and self.notify is not None:
+            try:
+                self.notify(accepted)
+            except Exception:  # noqa: BLE001 — fanout best-effort
+                pass
         return added
 
     def last(
@@ -335,6 +346,16 @@ class Monitor(Dispatcher):
         # reports age out with the slow-op grace (a dead mgr must not
         # pin SLO_LATENCY forever)
         self.slo_reports: dict[str, tuple[float, str, str]] = {}
+        # PGMap digest pushed by the mgr pgmap module ("pgmap
+        # report"): (wallclock received, digest dict).  Feeds the
+        # `ceph status` pgmap section, `ceph df`, the grown `pg
+        # dump`, and PG_DEGRADED / PG_AVAILABILITY; silence past the
+        # stat-report grace drops it (dead mgr ≠ healthy PGs)
+        self.pgmap: tuple[float, dict] | None = None
+        # `ceph -w` watch subscribers: conn -> {level, debug,
+        # dout_mark}; fed by the clog_store notify fanout below
+        self._watch_subs: dict[Connection, dict] = {}
+        self.clog_store.notify = self._push_watch
         # last health-check code set, so transitions (raise/clear)
         # write the cluster log — the health timeline
         self._prev_health: set[str] = set()
@@ -426,6 +447,17 @@ class Monitor(Dispatcher):
                 }
             ]
         )
+
+    def pgmap_digest(self) -> dict | None:
+        """The freshest mgr-pushed PGMap digest, or None when the
+        mgr has gone silent past the stat-report grace (a dead mgr's
+        last digest must not keep reporting healthy PGs)."""
+        if self.pgmap is None:
+            return None
+        ts, digest = self.pgmap
+        if time.time() - ts > STAT_REPORT_GRACE:
+            return None
+        return digest
 
     # -- health (HealthMonitor role) ---------------------------------------
     def health_checks(self) -> dict[str, dict]:
@@ -583,6 +615,40 @@ class Monitor(Dispatcher):
                 del self.slo_reports[code]
                 continue
             checks[code] = {"severity": severity, "summary": summary}
+        # PG_DEGRADED / PG_AVAILABILITY (PGMap::get_health_checks):
+        # from the mgr's pgmap digest; a stale digest (dead mgr)
+        # drops the checks rather than pinning them forever
+        digest = self.pgmap_digest()
+        if digest is not None:
+            t = digest.get("totals", {})
+            degraded = int(t.get("degraded", 0))
+            unfound = int(t.get("unfound", 0))
+            objects = max(int(t.get("objects", 0)), 1)
+            if degraded or unfound:
+                replicas = objects  # reported objects ≈ placements led
+                checks["PG_DEGRADED"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": (
+                        f"Degraded data redundancy: {degraded}/"
+                        f"{replicas} objects degraded"
+                        + (f", {unfound} unfound" if unfound else "")
+                    ),
+                }
+            # inactive = reported pgs not in an active state; pools
+            # whose primaries have not reported at all stay unknown,
+            # not unavailable
+            inactive = sum(
+                1 for row in digest.get("pgs", {}).values()
+                if not str(row.get("state", "")).startswith("active")
+            )
+            if inactive > 0:
+                checks["PG_AVAILABILITY"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": (
+                        "Reduced data availability: "
+                        f"{inactive} pgs inactive"
+                    ),
+                }
         cur = set(checks)
         for code in sorted(cur - self._prev_health):
             self._clog(
@@ -664,7 +730,20 @@ class Monitor(Dispatcher):
                     )
             return True
         if isinstance(msg, MMonCommand):
-            reply = self.handle_command(msg.cmd)
+            # "log subscribe" needs the CONNECTION (the watch stream
+            # pushes back on it), which command handlers never see —
+            # intercept here, before the handler table
+            try:
+                cmd = json.loads(msg.cmd)
+            except ValueError:
+                cmd = None
+            if (
+                isinstance(cmd, dict)
+                and cmd.get("prefix") == "log subscribe"
+            ):
+                reply = self._watch_subscribe(conn, cmd)
+            else:
+                reply = self.handle_command(msg.cmd)
             reply.tid = msg.tid
             conn.send(reply)
             return True
@@ -672,6 +751,77 @@ class Monitor(Dispatcher):
 
     def ms_handle_reset(self, conn: Connection) -> None:
         self._subs.pop(conn, None)
+        self._watch_subs.pop(conn, None)
+
+    # -- `ceph -w` watch stream (the MLog subscription shape) --------------
+    def _watch_subscribe(
+        self, conn: Connection, cmd: dict
+    ) -> MMonCommandReply:
+        level = str(cmd.get("level", "info"))
+        if level not in _CLOG_PRIOS:
+            level = "info"
+        with self._lock:
+            self._watch_subs[conn] = {
+                "level": level,
+                "debug": bool(cmd.get("debug", False)),
+                # dout watermark: the firehose streams only entries
+                # newer than the subscription
+                "dout_mark": time.time(),
+            }
+        return MMonCommandReply(
+            outb=json.dumps({"subscribed": True, "level": level})
+        )
+
+    def _push_watch(self, entries: list[dict]) -> None:
+        """clog fanout (LogStore.notify): every accepted entry
+        streams to each subscriber that clears its level floor, as an
+        MLog batch; ``--watch-debug`` subscribers additionally get
+        the fresh dout-ring tail as channel="debug" entries."""
+        if not self._watch_subs:
+            return
+        from ..common.log import log as _dout_ring
+        from ..common.log_client import prio_rank
+
+        for conn, sub in list(self._watch_subs.items()):
+            if conn.is_closed:
+                self._watch_subs.pop(conn, None)
+                continue
+            floor = prio_rank(sub["level"])
+            batch = [
+                e for e in entries
+                if prio_rank(e.get("prio", "info")) >= floor
+            ]
+            if sub["debug"]:
+                fresh = [
+                    r for r in _dout_ring().dump_recent()
+                    if r["stamp"] > sub["dout_mark"]
+                ]
+                if fresh:
+                    sub["dout_mark"] = max(
+                        r["stamp"] for r in fresh
+                    )
+                    batch.extend(
+                        {
+                            "name": "mon.0",
+                            "stamp": r["stamp"],
+                            "channel": "debug",
+                            "prio": "debug",
+                            "message": (
+                                f"[{r['subsys']}:{r['level']}] "
+                                f"{r['message']}"
+                            ),
+                            "seq": 0,
+                        }
+                        for r in fresh
+                    )
+            if not batch:
+                continue
+            try:
+                conn.send(
+                    MLog(name="mon.0", entries=json.dumps(batch))
+                )
+            except (MessageError, OSError):
+                self._watch_subs.pop(conn, None)
 
     # -- command surface (MonCommands.h role) ------------------------------
     # read-only or high-rate periodic chatter: never audit-logged
@@ -687,6 +837,7 @@ class Monitor(Dispatcher):
             "mds beacon", "mgr beacon", "osd slow ops",
             "crash report", "osd scrub errors", "osd stat report",
             "osd df", "osd perf", "slo report",
+            "pgmap report", "df",
         }
     )
 
@@ -737,14 +888,79 @@ def _cmd_status(mon: Monitor, cmd: dict) -> MMonCommandReply:
         for o in range(m.max_osd)
         if m.exists(o) and m.osd_weight[o] > 0
     )
+    status = {
+        "epoch": m.epoch,
+        "num_osds": m.max_osd,
+        "num_up_osds": up,
+        "num_in_osds": inn,
+        "num_pools": len(m.pools),
+    }
+    digest = mon.pgmap_digest()
+    if digest is not None:
+        # the reference's `ceph status` data/io section (PGMap::print_summary)
+        status["pgmap"] = {
+            "num_pgs": digest.get("num_pgs", 0),
+            "pgs_by_state": digest.get("pg_states", {}),
+            "data": digest.get("totals", {}),
+            "io": digest.get("io", {}),
+            "recovery": digest.get("recovery", {}),
+        }
+    return MMonCommandReply(outb=json.dumps(status))
+
+
+def _cmd_pgmap_report(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """The mgr pgmap module's digest push.  Bounded validation (the
+    slo-report idiom): the digest travels base64(binary) and must
+    decode through the pinned codec or the push is rejected."""
+    import base64 as _b64
+
+    from ..mgr.pgmap import decode_pgmap_digest
+
+    raw = cmd.get("digest")
+    if not isinstance(raw, str) or len(raw) > 4 << 20:
+        return MMonCommandReply(rc=-22, outs="bad digest")
+    try:
+        digest = decode_pgmap_digest(_b64.b64decode(raw))
+    except Exception:  # noqa: BLE001 — reject, never crash the mon
+        return MMonCommandReply(rc=-22, outs="undecodable digest")
+    mon.pgmap = (time.time(), digest)
+    return MMonCommandReply(outb=json.dumps({"ok": True}))
+
+
+def _cmd_df(mon: Monitor, cmd: dict) -> MMonCommandReply:
+    """'ceph df': cluster fill from the per-OSD stat reports +
+    per-pool stored/objects from the pgmap digest."""
+    now = time.time()
+    kb = kb_used = kb_avail = 0
+    for _osd, (ts, k, ku, ka) in list(mon.osd_stats.items()):
+        if now - ts > STAT_REPORT_GRACE:
+            continue
+        kb += k
+        kb_used += ku
+        kb_avail += ka
+    digest = mon.pgmap_digest() or {}
+    pools = []
+    for pid in sorted(mon.osdmap.pools):
+        p = (digest.get("pools") or {}).get(pid, {})
+        pools.append(
+            {
+                "id": pid,
+                "name": mon.osdmap.pool_names.get(pid, str(pid)),
+                "stored": p.get("bytes", 0),
+                "objects": p.get("objects", 0),
+                "degraded": p.get("degraded", 0),
+                "misplaced": p.get("misplaced", 0),
+            }
+        )
     return MMonCommandReply(
         outb=json.dumps(
             {
-                "epoch": m.epoch,
-                "num_osds": m.max_osd,
-                "num_up_osds": up,
-                "num_in_osds": inn,
-                "num_pools": len(m.pools),
+                "stats": {
+                    "total_bytes": kb * 1024,
+                    "total_used_bytes": kb_used * 1024,
+                    "total_avail_bytes": kb_avail * 1024,
+                },
+                "pools": pools,
             }
         )
     )
@@ -1480,19 +1696,38 @@ def _cmd_pg_dump(mon: Monitor, cmd: dict) -> MMonCommandReply:
     """'ceph pg dump': every pool PG with its up/acting sets (the
     OSDMonitor side of pg listing; per-PG I/O stats live on the mgr)."""
     m = mon.osdmap
+    digest_pgs = (mon.pgmap_digest() or {}).get("pgs", {})
     pgs = []
     for pid, pool in m.pools.items():
         for ps in range(pool.pg_num):
             up, upp, acting, actingp = m.pg_to_up_acting_osds(pid, ps)
-            pgs.append(
-                {
-                    "pgid": f"{pid}.{ps}",
-                    "up": up,
-                    "up_primary": upp,
-                    "acting": acting,
-                    "acting_primary": actingp,
-                }
-            )
+            row = {
+                "pgid": f"{pid}.{ps}",
+                "up": up,
+                "up_primary": upp,
+                "acting": acting,
+                "acting_primary": actingp,
+            }
+            # states + counts from the mgr digest (the PGMap side of
+            # pg dump); unreported pgs keep the map-only row
+            st = digest_pgs.get(row["pgid"])
+            if st is not None:
+                row.update(
+                    {
+                        "state": st.get("state", "unknown"),
+                        "num_objects": st.get("objects", 0),
+                        "num_bytes": st.get("bytes", 0),
+                        "num_objects_degraded": st.get("degraded", 0),
+                        "num_objects_misplaced": st.get(
+                            "misplaced", 0
+                        ),
+                        "num_objects_unfound": st.get("unfound", 0),
+                        "recovery_progress": st.get(
+                            "recovery_progress", 0.0
+                        ),
+                    }
+                )
+            pgs.append(row)
     return MMonCommandReply(outb=json.dumps({"pg_stats": pgs}))
 
 
@@ -2089,6 +2324,8 @@ _COMMANDS = {
     "osd tree": _cmd_osd_tree,
     "osd pool ls": _cmd_pool_ls,
     "pg dump": _cmd_pg_dump,
+    "pgmap report": _cmd_pgmap_report,
+    "df": _cmd_df,
     "health": _cmd_health,
     "health mute": _cmd_health_mute,
     "health unmute": _cmd_health_unmute,

@@ -1192,3 +1192,32 @@ class MCommand(Message):
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MCommand":
         return cls(cmd=d.string())
+
+
+@register_message
+@dataclass
+class MPGStats(Message):
+    """OSD → mgr per-PG statistics (src/messages/MPGStats.h): every
+    stat-report tick the OSD sends the PG-stat dicts for the PGs it
+    leads (state string, object/byte counts, degraded / misplaced /
+    unfound accounting, recovery watermark) plus any in-flight
+    progress events (scrub/repair chunks).  ``stats`` and ``events``
+    are JSON lists — the mgr folds them into the PGMap digest it
+    pushes to the mon."""
+
+    TYPE = 51
+    osd: int = 0
+    epoch: int = 0
+    stats: str = "[]"
+    events: str = "[]"
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.osd).u32(self.epoch)
+        e.string(self.stats).string(self.events)
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MPGStats":
+        return cls(
+            osd=d.s32(), epoch=d.u32(),
+            stats=d.string(), events=d.string(),
+        )

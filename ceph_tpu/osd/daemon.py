@@ -107,6 +107,7 @@ from ..msg.message import (
     MOSDBackoff,
     MRecoveryReserve,
     MMgrReport,
+    MPGStats,
     OSD_FLAG_FULL_TRY,
     OSD_OP_APPEND,
     OSD_OP_CALL,
@@ -634,6 +635,10 @@ class OSD(Dispatcher):
         # the scrub engine (osd/scrub.py): scheduling, reservations,
         # chunked runs, the ScrubStore, and repair
         self.scrubber = Scrubber(self)
+        # scrub/repair runs already reported as progress events, so
+        # the final done=True record goes out exactly once when a
+        # run leaves the scrubber (MPGStats events field)
+        self._progress_seen: set[str] = set()
         self._boot_stamp = time.monotonic()
 
     # -- lifecycle ---------------------------------------------------------
@@ -3835,6 +3840,138 @@ class OSD(Dispatcher):
         peers.discard(CRUSH_ITEM_NONE)  # EC holes are not peers
         return peers
 
+    def collect_pg_stats(self) -> list[dict]:
+        """Per-PG pg_stat_t-analog dicts for the PGs this OSD leads
+        (src/osd/PG.cc publish_stats_to_osd role): state string with
+        qualifiers, object/byte counts from the store, and the
+        degraded/misplaced/unfound accounting the mgr PGMap digest
+        rolls up.  Primary-only — exactly one report per PG cluster-
+        wide, like the reference."""
+        osdmap = self.monc.osdmap
+        with self._pg_lock:
+            pgs = [
+                pg for pg in self.pgs.values()
+                if pg.primary == self.whoami
+                and pg.state in ("active", "peering", "initial")
+            ]
+        recovering = list(self._recovering.items())
+        out: list[dict] = []
+        for pg in pgs:
+            pool = osdmap.pools.get(pg.pool_id)
+            if pool is None:
+                continue
+            try:
+                ps = int(pg.pgid.split(".")[1])
+                up, _upp, _a, _p = osdmap.pg_to_up_acting_osds(
+                    pg.pool_id, ps
+                )
+            except (ValueError, IndexError, KeyError):
+                up = []
+            live_acting = [
+                o for o in pg.acting if o != CRUSH_ITEM_NONE
+            ]
+            holes = max(pool.size - len(live_acting), 0)
+            num_objects = 0
+            num_bytes = 0
+            try:
+                for o in self.store.list_objects(pg.cid):
+                    if not o.startswith(OBJ_PREFIX) or "@" in o:
+                        continue
+                    num_objects += 1
+                    num_bytes += self.store.stat(pg.cid, o)
+            except StoreError:
+                pass  # collection racing a remap/removal
+            ops = [
+                op for (pid, _osd), op in recovering
+                if pid == pg.pgid and not op.failed
+            ]
+            remaining = sum(len(op.remaining) for op in ops)
+            pushed = sum(len(op.pushed) for op in ops)
+            degraded = (
+                num_objects * holes
+                + remaining
+                + len(pg.self_missing)
+            )
+            misplaced = num_objects * sum(
+                1 for o in live_acting if o not in up
+            )
+            unfound = len(pg.self_missing)
+            quals = []
+            if pg.state != "active":
+                base = "peering"
+            else:
+                base = "active"
+                if holes:
+                    quals.append("undersized")
+                if degraded:
+                    quals.append("degraded")
+                if list(up) != list(pg.acting):
+                    quals.append("remapped")
+                if ops:
+                    quals.append(
+                        "backfilling"
+                        if any(op.since == (0, 0) for op in ops)
+                        else "recovering"
+                    )
+                if pg.scrub_errors:
+                    quals.append("inconsistent")
+                if not quals:
+                    quals.append("clean")
+            state = "+".join([base] + quals)
+            out.append({
+                "pgid": pg.pgid,
+                "state": state,
+                "num_objects": num_objects,
+                "num_bytes": num_bytes,
+                "num_objects_degraded": degraded,
+                "num_objects_misplaced": misplaced,
+                "num_objects_unfound": unfound,
+                "recovery": {
+                    "planned": remaining + pushed,
+                    "pushed": pushed,
+                },
+                "up": list(up),
+                "acting": list(pg.acting),
+                "reported_epoch": osdmap.epoch,
+            })
+        return out
+
+    def collect_progress_events(self) -> list[dict]:
+        """Progress events for this OSD's long-running local work —
+        currently scrub/repair runs (fraction = chunk index over the
+        run's object list).  A run that leaves the scrubber emits a
+        final done=True record exactly once (``_progress_seen``), so
+        the mgr progress module can retire the bar."""
+        events: list[dict] = []
+        live: set[str] = set()
+        for pgid, run in list(self.scrubber._runs.items()):
+            kind = (
+                "repair" if run.repair
+                else "deep-scrub" if run.deep
+                else "scrub"
+            )
+            eid = f"{kind} pg {pgid} (osd.{self.whoami})"
+            live.add(eid)
+            events.append({
+                "id": eid,
+                "message": eid,
+                "fraction": min(
+                    run.idx / max(len(run.oids), 1), 1.0
+                ),
+                "done": False,
+            })
+        for eid in list(self._progress_seen):
+            if eid not in live:
+                self._progress_seen.discard(eid)
+                events.append({
+                    "id": eid,
+                    "message": eid,
+                    "fraction": 1.0,
+                    "done": True,
+                })
+        self._progress_seen |= live
+        return events
+
     def _mgr_report_loop(self) -> None:
         """Dedicated thread: mgr discovery + MMgrReport pushes must
         never stall the tick (a slow/unreachable mgr would otherwise
@@ -3958,6 +4095,19 @@ class OSD(Dispatcher):
                 c for c in self._crash_sends if c not in live
             ]:
                 del self._crash_sends[cid]
+            # the PG-stats plane rides the same tick/connection: one
+            # MPGStats per push with this OSD's primary-PG stat dicts
+            # plus local progress events (scrub/repair)
+            self._mgr_conn.send(
+                MPGStats(
+                    osd=self.whoami,
+                    epoch=self.monc.osdmap.epoch,
+                    stats=json.dumps(self.collect_pg_stats()),
+                    events=json.dumps(
+                        self.collect_progress_events()
+                    ),
+                )
+            )
         except (MessageError, OSError, ValueError):
             self._mgr_conn = None
 

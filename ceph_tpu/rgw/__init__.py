@@ -230,6 +230,10 @@ class RGW:
         )
         self.perf = build_rgw_perf("rgw")
         self.index = BucketIndex(self)
+        # optional mgr progress-event bridge: callable (event_id,
+        # message, fraction, done) fed by the reshard state machine
+        # (index.py _report_progress); None = no progress reporting
+        self.progress_hook = None
         self.reshard_worker = None
         self._mgr_stop = None
         self._mgr_thread = None

@@ -645,6 +645,30 @@ class BucketIndex:
             "queued": queued,
         }
 
+    def _report_progress(
+        self, bucket: str, target_shards: int, fraction: float,
+        done: bool = False,
+    ) -> None:
+        """Feed the mgr progress-event plane: in-process gateways
+        set ``rgw.progress_hook`` (callable (event_id, message,
+        fraction, done)) — tests bridge it straight to the progress
+        module; out-of-process gateways use the mgr's
+        ``progress event`` command instead.  Best-effort: a broken
+        hook must never fail a reshard."""
+        hook = getattr(self.rgw, "progress_hook", None)
+        if hook is None:
+            return
+        try:
+            hook(
+                f"reshard:{bucket}",
+                f"Resharding bucket {bucket!r} to "
+                f"{target_shards} shards",
+                fraction,
+                done,
+            )
+        except Exception:  # noqa: BLE001 — observability side-channel
+            pass
+
     def _save_reshard_state(
         self, bucket: str, status: str, target_gen: int,
         target_shards: int,
@@ -761,6 +785,7 @@ class BucketIndex:
         t0 = time.monotonic()
         self.rgw.perf.inc("l_rgw_reshard_started")
         self.rgw.perf.inc("l_rgw_reshard_in_progress")
+        self._report_progress(bucket, target_shards, 0.0)
         try:
             lay = self._save_reshard_state(
                 bucket, RESHARD_IN_PROGRESS, lay.gen + 1,
@@ -779,6 +804,12 @@ class BucketIndex:
                 diffs = self._migrate_pass(bucket, lay)
                 passes += 1
                 entries = max(entries, diffs)
+                # convergent bar: each fixpoint pass halves what can
+                # remain, capped below the cutover's share
+                self._report_progress(
+                    bucket, target_shards,
+                    min(1.0 - 0.5 ** passes, 0.9),
+                )
                 # exit on a CLEAN pass (at least one pass ran);
                 # sustained write traffic is bounded by max_passes —
                 # the cutover park quiesces the stragglers
@@ -827,6 +858,9 @@ class BucketIndex:
                 except (ObjectNotFound, RadosError):
                     pass
             self.rgw.perf.inc("l_rgw_reshard_completed")
+            self._report_progress(
+                bucket, target_shards, 1.0, done=True
+            )
             with self._op_counts_lock:
                 self._op_counts.pop(bucket, None)
             return {

@@ -264,6 +264,20 @@ def _build_command(args: list[str]) -> dict:
             }
         sub = args[1] if len(args) > 1 else "status"
         return {"prefix": f"slo {sub}"}
+    if args[0] == "progress":
+        # mgr-targeted: progress | progress json | progress clear |
+        # progress event id=X fraction=F [message=...] [done=1]
+        sub = args[1] if len(args) > 1 else ""
+        if sub == "event":
+            cmd = {"prefix": "progress event"}
+            for kv in args[2:]:
+                if "=" in kv:
+                    k, _, v = kv.partition("=")
+                    cmd[k] = _coerce(v)
+            return cmd
+        return {"prefix": f"progress {sub}".strip()}
+    if args[0] == "df":
+        return {"prefix": "df"}
     if args[0] in ("status", "health"):
         return {"prefix": args[0]}
     # pass-through: let the monitor reject unknowns (same as the
@@ -286,6 +300,69 @@ def _mgr_command(msgr, mc, cmd: dict):
     return out
 
 
+def _watch(msgr, mc, level: str, debug: bool) -> int:
+    """`ceph -w`: subscribe to the mon's cluster-log stream and
+    print entries as they commit, until interrupted.  The mon pushes
+    MLog batches on the subscribed connection (the MLog subscription
+    shape); ``--watch-debug`` adds the mon's dout-ring firehose as
+    channel="debug" lines."""
+    import queue
+    import time as _time
+
+    from ..msg.message import MLog
+    from ..msg.messenger import Dispatcher
+
+    q: queue.Queue = queue.Queue()
+
+    class _WatchSink(Dispatcher):
+        def ms_dispatch(self, conn, msg):
+            if isinstance(msg, MLog):
+                q.put(msg)
+                return True
+            return False
+
+        def ms_handle_reset(self, conn):
+            q.put(None)
+
+    msgr.add_dispatcher(_WatchSink())
+    reply = mc.command(
+        {"prefix": "log subscribe", "level": level, "debug": debug}
+    )
+    if reply.rc != 0:
+        raise SystemExit(f"log subscribe failed: {reply.outs}")
+    st = mc.command({"prefix": "status"})
+    if st.rc == 0 and st.outb:
+        print(
+            json.dumps(json.loads(st.outb), indent=2), flush=True
+        )
+    try:
+        while True:
+            msg = q.get()
+            if msg is None:
+                print("connection to mon lost", file=sys.stderr)
+                return 1
+            try:
+                entries = json.loads(msg.entries)
+            except ValueError:
+                continue
+            for e in entries:
+                if not isinstance(e, dict):
+                    continue
+                stamp = _time.strftime(
+                    "%Y-%m-%d %H:%M:%S",
+                    _time.localtime(float(e.get("stamp", 0))),
+                )
+                print(
+                    f"{stamp} {e.get('name', '?')} "
+                    f"[{e.get('channel', 'cluster')}:"
+                    f"{e.get('prio', 'info')}] "
+                    f"{e.get('message', '')}",
+                    flush=True,
+                )
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="ceph", description=__doc__, add_help=True
@@ -297,9 +374,24 @@ def main(argv=None) -> int:
     p.add_argument(
         "-f", "--format", choices=["plain", "json"], default="plain"
     )
+    # explicit flags, declared BEFORE the REMAINDER command so
+    # argparse claims them (a REMAINDER would swallow `-w`)
+    p.add_argument(
+        "-w", "--watch", action="store_true",
+        help="stream the cluster log live (the `ceph -w` surface)",
+    )
+    p.add_argument(
+        "--watch-debug", action="store_true",
+        help="watch, including the mon's dout-ring firehose",
+    )
+    p.add_argument(
+        "--watch-level", default="debug",
+        help="minimum clog priority to stream (default: debug)",
+    )
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
-    if not args.command:
+    watching = args.watch or args.watch_debug
+    if not args.command and not watching:
         p.error("no command given")
     host, _, port = args.mon.partition(":")
 
@@ -307,9 +399,16 @@ def main(argv=None) -> int:
     try:
         mc = MonClient(msgr, whoami=-1)
         mc.connect(host, int(port))
+        if watching:
+            return _watch(
+                msgr, mc, args.watch_level, args.watch_debug
+            )
         cmd = _build_command(args.command)
         prefix = cmd["prefix"]
-        if prefix == "slo" or prefix.startswith(("slo ", "tracing ")):
+        if prefix == "progress" or prefix.startswith("progress "):
+            # mgr-module command (the progress module's surface)
+            reply = _mgr_command(msgr, mc, cmd)
+        elif prefix == "slo" or prefix.startswith(("slo ", "tracing ")):
             # mgr-module commands, like crash: the owning module
             # (first prefix word) serves them on the active mgr
             reply = _mgr_command(msgr, mc, cmd)

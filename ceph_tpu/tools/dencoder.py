@@ -111,6 +111,17 @@ def _sample_messages():
         "MRecoveryReserve": M.MRecoveryReserve(
             op="request", pgid="7.3", epoch=42, from_osd=1
         ),
+        # the PG-stats plane (ISSUE 16): OSD → mgr per-PG accounting
+        # + piggybacked progress events
+        "MPGStats": M.MPGStats(
+            osd=1, epoch=42,
+            stats='[{"pgid": "7.3", "state": "active+clean", '
+            '"num_objects": 4, "num_bytes": 4096, '
+            '"num_objects_degraded": 0}]',
+            events='[{"id": "scrub pg 7.3 (osd.1)", '
+            '"message": "scrub pg 7.3 (osd.1)", '
+            '"fraction": 0.5, "done": false}]',
+        ),
     }
     for name, msg in samples.items():
         msg.tid = 99
@@ -321,6 +332,48 @@ def _build_types():
         lambda: encode_reshard_entry(reshard_ent),
         lambda blob: encode_reshard_entry(
             decode_reshard_entry(blob)
+        ),
+    )
+
+    # the PGMap digest (mgr/pgmap.py): the mgr→mon rollup the status
+    # / df / health surfaces read — sorted-map encoding, so the same
+    # digest is always the same bytes
+    from ..mgr.pgmap import decode_pgmap_digest, encode_pgmap_digest
+
+    digest_sample = {
+        "version": 1,
+        "num_pgs": 8,
+        "num_pools": 1,
+        "pg_states": {"active+clean": 7, "active+degraded": 1},
+        "pools": {
+            1: {
+                "name": "data", "num_pgs": 8, "active_pgs": 8,
+                "objects": 24, "bytes": 49152, "degraded": 3,
+                "misplaced": 0, "unfound": 0,
+            }
+        },
+        "totals": {
+            "objects": 24, "bytes": 49152, "degraded": 3,
+            "misplaced": 0, "unfound": 0,
+        },
+        "io": {
+            "ops_sec": 12.5, "read_ops_sec": 4.5,
+            "write_ops_sec": 8.0,
+        },
+        "recovery": {"objects_sec": 2.0, "bytes_sec": 4096.0},
+        "pgs": {
+            "1.3": {
+                "state": "active+degraded", "objects": 3,
+                "bytes": 6144, "degraded": 3, "misplaced": 0,
+                "unfound": 0, "up": [0, 1, 2], "acting": [0, 1],
+                "reported_epoch": 7, "recovery_progress": 0.25,
+            }
+        },
+    }
+    types["pgmap_digest"] = (
+        lambda: encode_pgmap_digest(digest_sample),
+        lambda blob: encode_pgmap_digest(
+            decode_pgmap_digest(blob)
         ),
     )
     return types

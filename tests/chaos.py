@@ -547,10 +547,21 @@ def scenario_kill_osd_at_fill(seed: int = DEFAULT_SEED) -> dict:
     acting store holds byte-identical re-encoded shards), zero
     acknowledged writes are lost, every reservation is released, and
     the gold class's p99 stays bounded while the storm drains — the
-    SLO verdict rides the returned dict."""
+    SLO verdict rides the returned dict.
+
+    ISSUE 16 grows the observability verdict on top: an embedded mgr
+    (pgmap + progress modules) watches the same storm through the
+    public surfaces, and the scenario also asserts PG_DEGRADED raises
+    with a nonzero degraded count, the rebalance progress bar marches
+    monotonically to 1.0, a nonzero recovery rate shows in `ceph
+    status`, and at the end PG_DEGRADED clears with degraded and
+    misplaced both zero."""
     import numpy as np
 
     from test_ec_daemon import _base_map
+    from ceph_tpu.mgr import Manager
+    from ceph_tpu.mgr.pgmap import PgMapModule
+    from ceph_tpu.mgr.progress import ProgressModule
     from ceph_tpu.mon.monitor import Monitor
     from ceph_tpu.msg import Messenger
     from ceph_tpu.osd.daemon import OBJ_PREFIX
@@ -565,6 +576,10 @@ def scenario_kill_osd_at_fill(seed: int = DEFAULT_SEED) -> dict:
     mon_msgr = Messenger("mon")
     mon_msgr.add_dispatcher(mon)
     mon_addr = mon_msgr.bind()
+    # the observability plane: the mgr must be up BEFORE the OSDs so
+    # they discover it and the MPGStats stream covers the whole storm
+    mgr = Manager(modules=[PgMapModule, ProgressModule], name="chaos")
+    mgr.start(mon_addr)
     osds: dict[int, object] = {}
     stores: dict[int, object] = {}
 
@@ -662,6 +677,59 @@ def scenario_kill_osd_at_fill(seed: int = DEFAULT_SEED) -> dict:
         counters_before = {
             i: dict(o.perf.dump()) for i, o in osds.items()
         }
+
+        # observability sampler: watches the storm through the public
+        # command surface (status pgmap section, health checks) and
+        # the progress module's event table
+        rebalance_ev = f"rebalance:osd.{victim}-out"
+        obs = {
+            "degraded_peak": 0,
+            "recovery_rate_max": 0.0,
+            "pg_degraded_seen": False,
+            "fractions": [],
+        }
+        obs_stop = threading.Event()
+
+        def observe():
+            while not obs_stop.is_set():
+                try:
+                    rc2, outb, _o = client.mon_command(
+                        {"prefix": "status"}
+                    )
+                    if rc2 == 0:
+                        pgmap = json.loads(outb).get("pgmap", {})
+                        data = pgmap.get("data", {})
+                        obs["degraded_peak"] = max(
+                            obs["degraded_peak"],
+                            int(data.get("degraded", 0)),
+                        )
+                        obs["recovery_rate_max"] = max(
+                            obs["recovery_rate_max"],
+                            float(
+                                pgmap.get("recovery", {}).get(
+                                    "objects_sec", 0.0
+                                )
+                            ),
+                        )
+                    rc2, outb, _o = client.mon_command(
+                        {"prefix": "health"}
+                    )
+                    if rc2 == 0 and "PG_DEGRADED" in json.loads(
+                        outb
+                    ).get("checks_detail", {}):
+                        obs["pg_degraded_seen"] = True
+                    for ev in mgr.modules[
+                        "progress"
+                    ].active_events():
+                        if ev["id"] == rebalance_ev:
+                            obs["fractions"].append(ev["fraction"])
+                except (RadosError, ValueError, KeyError):
+                    pass
+                time.sleep(0.25)
+
+        obs_thread = threading.Thread(target=observe, daemon=True)
+        obs_thread.start()
+
         dead = osds.pop(victim)
         dead._stop.set()
         dead._workq.put(None)
@@ -670,6 +738,25 @@ def scenario_kill_osd_at_fill(seed: int = DEFAULT_SEED) -> dict:
         assert wait_for(
             lambda: not client.monc.osdmap.is_up(victim), 15.0
         ), "mon never marked the victim down"
+
+        # the down-but-not-out window IS the reference's
+        # mon_osd_down_out_interval (600s, never zero): hold the
+        # auto-out until the PG-stats pipeline (OSD stat tick →
+        # MPGStats → pgmap digest → mon) demonstrably surfaces the
+        # degradation through the public `ceph status` path — a
+        # sub-second out would let the rebuild outrun the 1 Hz
+        # reporting cadence and the storm would be invisible
+        def degraded_visible():
+            rc2, outb, _o = client.mon_command({"prefix": "status"})
+            if rc2 != 0:
+                return False
+            data = json.loads(outb).get("pgmap", {}).get("data", {})
+            return int(data.get("degraded", 0)) > 0
+
+        assert wait_for(degraded_visible, 20.0), (
+            "degraded count never surfaced in status after the kill"
+        )
+
         # mark it OUT so CRUSH re-places its positions (the operator/
         # mgr role of the reference's mon_osd_down_out_interval
         # auto-out) — this is what turns the death into a rebuild
@@ -707,6 +794,65 @@ def scenario_kill_osd_at_fill(seed: int = DEFAULT_SEED) -> dict:
         t.join(timeout=20)
         # let the final in-flight writes replicate + any re-peer settle
         assert wait_for(rebuilt, 30.0), "cluster fell back out of active"
+
+        # observability verdict: the progress bar for the out-remap
+        # must complete (fraction 1.0, done) — completed events stay
+        # listed until the TTL retires them, so this window is safe
+        prog = mgr.modules["progress"]
+
+        def bar_done():
+            return any(
+                ev["id"] == rebalance_ev
+                and ev["done"]
+                and ev["fraction"] >= 1.0
+                for ev in prog.active_events()
+            )
+
+        assert wait_for(bar_done, 30.0), (
+            "rebalance progress event never completed: "
+            f"{prog.active_events()}"
+        )
+        # one last genuine sample so the series always ends at done
+        for ev in prog.active_events():
+            if ev["id"] == rebalance_ev:
+                obs["fractions"].append(ev["fraction"])
+        obs_stop.set()
+        obs_thread.join(timeout=5)
+
+        fr = obs["fractions"]
+        assert fr and fr[-1] >= 1.0, f"bar never reached 1.0: {fr}"
+        progress_monotone = all(
+            b >= a for a, b in zip(fr, fr[1:])
+        )
+        assert progress_monotone, f"progress regressed: {fr}"
+        assert obs["degraded_peak"] > 0, (
+            "PG stats never showed the storm degraded"
+        )
+        assert obs["pg_degraded_seen"], "PG_DEGRADED never raised"
+        assert obs["recovery_rate_max"] > 0, (
+            "recovery rate never surfaced in status"
+        )
+
+        # ... and the storm over means the checks CLEAR and the
+        # digest drains to zero degraded/misplaced
+        def quiet():
+            rc2, outb, _o = client.mon_command({"prefix": "health"})
+            if rc2 != 0 or "PG_DEGRADED" in json.loads(outb).get(
+                "checks_detail", {}
+            ):
+                return False
+            rc2, outb, _o = client.mon_command({"prefix": "status"})
+            if rc2 != 0:
+                return False
+            data = json.loads(outb).get("pgmap", {}).get("data", {})
+            return (
+                int(data.get("degraded", 0)) == 0
+                and int(data.get("misplaced", 0)) == 0
+            )
+
+        assert wait_for(quiet, 30.0), (
+            "PG_DEGRADED never cleared / digest never drained"
+        )
 
         # zero acked-write loss
         for oid, data in sorted(acked.items()):
@@ -790,10 +936,16 @@ def scenario_kill_osd_at_fill(seed: int = DEFAULT_SEED) -> dict:
             "recovery_survivor_shards": fanin,
             "client_errors": len(errors),
             "slo": verdict,
+            "progress_monotone": progress_monotone,
+            "progress_samples": len(fr),
+            "degraded_peak": obs["degraded_peak"],
+            "recovery_rate_max": round(obs["recovery_rate_max"], 2),
+            "pg_degraded_raised": obs["pg_degraded_seen"],
         }
     finally:
         if client is not None:
             client.shutdown()
+        mgr.shutdown()
         for o in osds.values():
             o._stop.set()
             o._workq.put(None)

@@ -4,6 +4,7 @@ blobs, and checkpoint compression in KStore."""
 
 from __future__ import annotations
 
+import importlib.util
 import os
 
 import pytest
@@ -13,6 +14,11 @@ from ceph_tpu.compressor import (
     available,
     create,
 )
+
+# the zstd plugin needs the `zstandard` python module; some
+# containers ship without it, and that specific absence (not a
+# plugin-registry regression) is the only legitimate skip
+_HAVE_ZSTD = importlib.util.find_spec("zstandard") is not None
 
 PAYLOADS = [
     b"",
@@ -38,9 +44,17 @@ def test_roundtrip_every_plugin(name):
 def test_expected_plugins_present():
     names = available()
     assert "none" in names and "zlib" in names
-    # the baked image carries zstd; gate like the reference gates
-    # build-time libraries
-    assert "zstd" in names
+
+
+@pytest.mark.skipif(
+    not _HAVE_ZSTD,
+    reason="python module 'zstandard' not installed in this image",
+)
+def test_zstd_plugin_present():
+    # gate like the reference gates build-time libraries: zstd is
+    # expected wherever its backing library exists, and its absence
+    # must be exactly the missing `zstandard` module
+    assert "zstd" in available()
 
 
 def test_factory_unknown_and_corrupt():

@@ -1,0 +1,500 @@
+"""PG-stats + progress plane (ISSUE 16): per-PG accounting flowing
+OSD → mgr (MPGStats) → pgmap digest → mon, the health checks and
+command surfaces it feeds (`ceph status` pgmap section, `ceph df`,
+the grown `pg dump`), the mgr progress module's event lifecycle, and
+the `ceph -w` watch stream — all over a live mini-cluster."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.mgr import Manager
+from ceph_tpu.mgr.pgmap import (
+    PgMapModule,
+    decode_pgmap_digest,
+    encode_pgmap_digest,
+    pgmap_exposition_lines,
+)
+from ceph_tpu.mgr.progress import ProgressModule
+from ceph_tpu.msg.messenger import wait_for
+from ceph_tpu.osd.daemon import OBJ_PREFIX
+from ceph_tpu.rados import Rados
+
+from test_osd_daemon import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster()
+    for i in range(3):
+        c.start_osd(i)
+    c.wait_active()
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    r = Rados("pgstats-test").connect(*cluster.mon_addr)
+    r.pool_create("obspool", pg_num=4, size=3)
+    try:
+        yield r
+    finally:
+        r.shutdown()
+
+
+def _health_checks(client) -> dict:
+    rc, outb, _outs = client.mon_command({"prefix": "health"})
+    if rc != 0:
+        return {}
+    return json.loads(outb).get("checks_detail", {})
+
+
+def _status_pgmap(client) -> dict:
+    rc, outb, _outs = client.mon_command({"prefix": "status"})
+    if rc != 0:
+        return {}
+    return json.loads(outb).get("pgmap", {})
+
+
+# -- pure units --------------------------------------------------------------
+def test_digest_codec_roundtrip_byte_stable():
+    digest = {
+        "version": 1,
+        "num_pgs": 6,
+        "num_pools": 2,
+        "pg_states": {"active+clean": 5, "active+degraded": 1},
+        "pools": {
+            1: {
+                "name": "a", "num_pgs": 4, "active_pgs": 4,
+                "objects": 10, "bytes": 4096, "degraded": 0,
+                "misplaced": 0, "unfound": 0,
+            },
+        },
+        "totals": {
+            "objects": 10, "bytes": 4096, "degraded": 3,
+            "misplaced": 1, "unfound": 0,
+        },
+        "io": {"ops_sec": 1.5, "read_ops_sec": 0.5,
+               "write_ops_sec": 1.0},
+        "recovery": {"objects_sec": 2.0, "bytes_sec": 8192.0},
+        "pgs": {
+            "1.0": {
+                "state": "active+clean", "objects": 10,
+                "bytes": 4096, "degraded": 0, "misplaced": 0,
+                "unfound": 0, "up": [0, 1], "acting": [0, 1],
+                "reported_epoch": 7, "recovery_progress": 1.0,
+            },
+        },
+    }
+    blob = encode_pgmap_digest(digest)
+    back = decode_pgmap_digest(blob)
+    assert back["totals"]["degraded"] == 3
+    assert back["pgs"]["1.0"]["acting"] == [0, 1]
+    # canonical: re-encoding the decode is byte-identical (the
+    # dencoder pin depends on this)
+    assert encode_pgmap_digest(back) == blob
+
+
+def test_exposition_families_present():
+    digest = {
+        "totals": {"objects": 1, "bytes": 2, "degraded": 3,
+                   "misplaced": 4, "unfound": 5},
+        "pg_states": {"active+clean": 6},
+        "pools": {1: {"name": "p", "objects": 1, "bytes": 2}},
+    }
+    text = "\n".join(pgmap_exposition_lines(digest))
+    for family in (
+        "ceph_pg_degraded", "ceph_pg_misplaced", "ceph_pg_unfound",
+        "ceph_pg_state", "ceph_pool_stored_bytes",
+        "ceph_pool_objects",
+    ):
+        assert f"# TYPE {family} gauge" in text, family
+    # ceph_pg_total is served from pg_summary by the exporter — the
+    # pgmap renderer emitting it too would duplicate the family
+    assert "ceph_pg_total" not in text
+
+
+def test_cli_command_shapes():
+    from ceph_tpu.tools.ceph_cli import _build_command as b
+
+    assert b(["df"]) == {"prefix": "df"}
+    assert b(["progress"]) == {"prefix": "progress"}
+    assert b(["progress", "json"]) == {"prefix": "progress json"}
+    ev = b(["progress", "event", "id=x", "fraction=0.5", "done=1"])
+    assert ev["prefix"] == "progress event" and ev["id"] == "x"
+
+
+# -- OSD-side collection ------------------------------------------------------
+def test_scrub_progress_collection_contract(cluster):
+    """collect_progress_events: an in-flight scrub run reports its
+    chunk fraction; a finished run emits done=True exactly once."""
+    from ceph_tpu.osd.scrub import _Run
+
+    osd = cluster.osds[0]
+    run = _Run("9.0", True, False, 1, [0, 1, 2])
+    run.oids = [f"o{i}" for i in range(10)]
+    run.idx = 4
+    osd.scrubber._runs["9.0"] = run
+    try:
+        evs = {
+            e["id"]: e for e in osd.collect_progress_events()
+        }
+        eid = "deep-scrub pg 9.0 (osd.0)"
+        assert eid in evs and not evs[eid]["done"]
+        assert evs[eid]["fraction"] == pytest.approx(0.4)
+        run.idx = 10
+        evs = {e["id"]: e for e in osd.collect_progress_events()}
+        assert evs[eid]["fraction"] == pytest.approx(1.0)
+    finally:
+        osd.scrubber._runs.pop("9.0", None)
+    # the run left the scrubber: exactly one done record, then silence
+    done = [
+        e for e in osd.collect_progress_events() if e["id"] == eid
+    ]
+    assert len(done) == 1 and done[0]["done"]
+    assert done[0]["fraction"] == 1.0
+    assert not [
+        e for e in osd.collect_progress_events() if e["id"] == eid
+    ]
+
+
+def test_progress_module_folds_piggybacked_events():
+    """The mgr progress module drains MPGStats-piggybacked events:
+    start → monotone update → done, and a short TTL retires it."""
+    mgr = Manager.__new__(Manager)  # no messenger: module-only
+    mgr.module_options = {"progress": {"ttl": 0.0}}
+    mgr.monc = type("MC", (), {"osdmap": None})()
+    mgr.modules = {}
+    from collections import deque
+
+    mgr._progress_inbox = deque()
+    mgr.clog = type(
+        "Clog", (), {"info": lambda self, m: None}
+    )()
+    mod = ProgressModule(mgr)
+    mgr.modules["progress"] = mod
+    mgr._progress_inbox.append(
+        {"id": "scrub pg 1.0 (osd.0)", "message": "scrubbing",
+         "fraction": 0.25, "done": False}
+    )
+    mod._drain_inbox()
+    (ev,) = mod.active_events()
+    assert ev["fraction"] == 0.25 and not ev["done"]
+    # a regressing fraction is clamped monotone
+    mgr._progress_inbox.append(
+        {"id": "scrub pg 1.0 (osd.0)", "fraction": 0.1}
+    )
+    mod._drain_inbox()
+    assert mod.active_events()[0]["fraction"] == 0.25
+    mgr._progress_inbox.append(
+        {"id": "scrub pg 1.0 (osd.0)", "done": True}
+    )
+    mod._drain_inbox()
+    (ev,) = mod.active_events()
+    assert ev["done"] and ev["fraction"] == 1.0
+    with mod._lock:
+        mod._retire()  # ttl 0: completed events drop immediately
+    assert mod.active_events() == []
+
+
+# -- live digest truth --------------------------------------------------------
+def test_digest_matches_store_truth_df_and_pg_dump(cluster, client):
+    """The pgmap digest's per-pool counts equal direct enumeration
+    of the primaries' stores, and the same numbers serve `ceph df`
+    and the grown `pg dump`."""
+    io = client.open_ioctx("obspool")
+    written = {}
+    for i in range(24):
+        data = bytes([1 + i % 250]) * (512 + 64 * i)
+        io.write_full(f"truth-{i:03d}", data)
+        written[f"truth-{i:03d}"] = len(data)
+
+    mgr = Manager(modules=[PgMapModule], name="truth")
+    mgr.start(cluster.mon_addr)
+    try:
+        pgm = mgr.modules["pgmap"]
+        pool_id = next(
+            pid for pid, nm in client.monc.osdmap.pool_names.items()
+            if nm == "obspool"
+        )
+
+        def pool_row():
+            return (pgm.digest or {}).get("pools", {}).get(pool_id)
+
+        assert wait_for(
+            lambda: (pool_row() or {}).get("objects", 0)
+            >= len(written),
+            20.0,
+        ), f"digest never filled: {pool_row()}"
+        row = pool_row()
+
+        # ground truth: walk the primaries' stores directly
+        truth_objects = truth_bytes = 0
+        pool = client.monc.osdmap.pools[pool_id]
+        for ps in range(pool.pg_num):
+            _u, _upp, _a, primary = (
+                client.monc.osdmap.pg_to_up_acting_osds(pool_id, ps)
+            )
+            store = cluster.osds[primary].store
+            cid = f"pg_{pool_id}.{ps}"
+            for o in store.list_objects(cid):
+                if not o.startswith(OBJ_PREFIX) or "@" in o:
+                    continue
+                truth_objects += 1
+                truth_bytes += store.stat(cid, o)
+        assert row["objects"] == truth_objects == len(written)
+        assert row["bytes"] == truth_bytes == sum(written.values())
+        assert row["degraded"] == 0 and row["unfound"] == 0
+
+        # the digest reached the mon: status pgmap section agrees
+        assert wait_for(
+            lambda: _status_pgmap(client)
+            .get("data", {})
+            .get("objects", 0)
+            >= len(written),
+            10.0,
+        )
+        # `ceph df` serves the same per-pool stored/objects
+        rc, outb, outs = client.mon_command({"prefix": "df"})
+        assert rc == 0, outs
+        df = json.loads(outb)
+        (obsrow,) = [
+            p for p in df["pools"] if p["name"] == "obspool"
+        ]
+        assert obsrow["objects"] == truth_objects
+        assert obsrow["stored"] == truth_bytes
+        assert df["stats"]["total_bytes"] > 0
+        # `pg dump` rows grew states + counts
+        rc, outb, outs = client.mon_command({"prefix": "pg dump"})
+        assert rc == 0, outs
+        dump = json.loads(outb)
+        rows = {
+            r["pgid"]: r for r in dump["pg_stats"]
+            if r["pgid"].startswith(f"{pool_id}.")
+        }
+        assert len(rows) == pool.pg_num
+        assert sum(r["num_objects"] for r in rows.values()) == (
+            truth_objects
+        )
+        for r in rows.values():
+            assert r["state"].startswith("active")
+            assert r["num_objects_degraded"] == 0
+            assert "recovery_progress" in r
+    finally:
+        mgr.shutdown()
+
+
+# -- the lifecycle verdict ----------------------------------------------------
+def test_kill_osd_degraded_progress_lifecycle(cluster, client):
+    """The tier-1 variant of the chaos acceptance: kill an OSD →
+    PG_DEGRADED raises with a nonzero degraded count → out opens a
+    rebalance progress event → revive + in drains it → fraction
+    reaches 1.0, PG_DEGRADED clears, and the short-TTL event
+    retires."""
+    io = client.open_ioctx("obspool")
+    for i in range(16):
+        io.write_full(f"life-{i:02d}", bytes([7]) * 1024)
+
+    mgr = Manager(modules=[PgMapModule, ProgressModule], name="life")
+    mgr.set_module_option("progress", "ttl", 1.0)
+    mgr.start(cluster.mon_addr)
+    victim = 2
+    ev_id = f"rebalance:osd.{victim}-out"
+    # per-event fraction series: marking the OSD back IN opens its
+    # own rebalance event — monotonicity is a per-bar property
+    fractions: dict[str, list[float]] = {}
+    try:
+        prog = mgr.modules["progress"]
+        # the progress module must see the pre-kill map or the out
+        # transition is its "first sight" (deliberately skipped)
+        assert wait_for(lambda: prog._prev_out is not None, 10.0)
+
+        old_store = cluster.osds[victim].store
+        cluster.kill_osd(victim)
+        assert wait_for(
+            lambda: not client.monc.osdmap.is_up(victim), 15.0
+        ), "mon never marked the victim down"
+
+        # PG_DEGRADED raises off the digest with a real count
+        assert wait_for(
+            lambda: "PG_DEGRADED" in _health_checks(client), 20.0
+        ), f"PG_DEGRADED never raised: {_health_checks(client)}"
+        assert wait_for(
+            lambda: _status_pgmap(client)
+            .get("data", {})
+            .get("degraded", 0)
+            > 0,
+            10.0,
+        )
+
+        # out → the rebalance progress event opens
+        rc, _outb, outs = client.mon_command(
+            {"prefix": "osd out", "id": victim}
+        )
+        assert rc == 0, outs
+
+        def event_fraction():
+            for ev in prog.active_events():
+                if ev["id"] == ev_id:
+                    fractions.setdefault(ev_id, []).append(
+                        ev["fraction"]
+                    )
+                    return True
+            return False
+
+        assert wait_for(event_fraction, 20.0), (
+            f"rebalance event never opened: {prog.active_events()}"
+        )
+
+        # revive the victim (same store: log-driven recovery) and
+        # mark it back in — the remap drains and the bar completes
+        cluster.start_osd(victim, store=old_store)
+        assert wait_for(
+            lambda: client.monc.osdmap.is_up(victim), 15.0
+        )
+        rc, _outb, outs = client.mon_command(
+            {"prefix": "osd in", "id": victim}
+        )
+        assert rc == 0, outs
+
+        seen_done = threading.Event()
+        retired = threading.Event()
+
+        def settled():
+            found = False
+            for ev in prog.active_events():
+                if ev["id"].startswith("rebalance:"):
+                    found = True
+                    fractions.setdefault(ev["id"], []).append(
+                        ev["fraction"]
+                    )
+                    if ev["id"] == ev_id and ev["done"]:
+                        seen_done.set()
+            if not found and seen_done.is_set():
+                retired.set()
+            checks = _health_checks(client)
+            if "PG_DEGRADED" in checks:
+                return False
+            data = _status_pgmap(client).get("data", {})
+            return (
+                retired.is_set()
+                and int(data.get("degraded", -1)) == 0
+                and int(data.get("misplaced", -1)) == 0
+            )
+
+        assert wait_for(settled, 60.0), (
+            f"lifecycle never settled: events="
+            f"{prog.active_events()} "
+            f"health={list(_health_checks(client))} "
+            f"pgmap={_status_pgmap(client).get('data')}"
+        )
+        out_fr = fractions.get(ev_id, [])
+        assert out_fr and out_fr[-1] >= 1.0, fractions
+        for eid, fr in fractions.items():
+            assert all(
+                b >= a for a, b in zip(fr, fr[1:])
+            ), f"{eid} regressed: {fr}"
+    finally:
+        mgr.shutdown()
+        if victim not in cluster.osds:
+            cluster.start_osd(victim)
+        client.mon_command({"prefix": "osd in", "id": victim})
+
+
+# -- the watch stream ---------------------------------------------------------
+def test_watch_streams_injected_log_entries(cluster, client):
+    """`ceph -w` in its own process: prints the status snapshot
+    first, then streams cluster-log entries in commit order."""
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "ceph_tpu.tools.ceph_cli",
+            "-m",
+            f"{cluster.mon_addr[0]}:{cluster.mon_addr[1]}",
+            "-w",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    lines: list[str] = []
+
+    def reader():
+        for line in proc.stdout:
+            lines.append(line.rstrip("\n"))
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    try:
+        # the status JSON prints after the subscription is live
+        assert wait_for(
+            lambda: any(ln.startswith("{") for ln in lines), 20.0
+        ), f"no status snapshot: {lines}"
+        markers = [f"watch-mark-{i}" for i in range(3)]
+        for m in markers:
+            rc, _outb, outs = client.mon_command(
+                {"prefix": "log", "logtext": m}
+            )
+            assert rc == 0, outs
+
+        # match the injected entries themselves, not the audit-channel
+        # echo of the `ceph log` command that carried them
+        def is_entry(ln, m):
+            return "[cluster:info]" in ln and ln.endswith(m)
+
+        def all_seen():
+            return all(
+                any(is_entry(ln, m) for ln in lines)
+                for m in markers
+            )
+
+        assert wait_for(all_seen, 20.0), f"stream lost: {lines}"
+        idx = [
+            next(
+                i for i, ln in enumerate(lines)
+                if is_entry(ln, m)
+            )
+            for m in markers
+        ]
+        assert idx == sorted(idx), f"entries out of order: {lines}"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+# -- reshard feeds the same event API ----------------------------------------
+def test_reshard_reports_progress_through_hook(cluster, client):
+    """BucketIndex.reshard drives the RGW progress hook: opens at
+    0.0, advances monotonically per migrate pass, completes at 1.0
+    with done=True."""
+    from ceph_tpu.rgw import RGW
+
+    client.pool_create("obsrgw", pg_num=2, size=2)
+    gw = RGW(client.open_ioctx("obsrgw"))
+    calls: list[tuple] = []
+    gw.progress_hook = (
+        lambda ev_id, message, fraction, done=False: calls.append(
+            (ev_id, message, fraction, done)
+        )
+    )
+    gw.create_bucket("obsbucket")
+    for i in range(12):
+        gw.put_object("obsbucket", f"k{i:02d}", f"v{i}".encode())
+    st = gw.bucket_reshard("obsbucket", 4)
+    assert st["to_shards"] == 4
+    assert calls, "reshard never reported progress"
+    ids = {c[0] for c in calls}
+    assert ids == {"reshard:obsbucket"}
+    assert calls[0][2] == 0.0 and not calls[0][3]
+    assert calls[-1][2] == 1.0 and calls[-1][3]
+    fr = [c[2] for c in calls]
+    assert all(b >= a for a, b in zip(fr, fr[1:])), fr
+    assert "obsbucket" in calls[0][1]

@@ -642,6 +642,110 @@ def product_histogram_exposition() -> list[str]:
     return errors
 
 
+# PG-stats plane families the pgmap renderer must emit (mgr/pgmap.py
+# pgmap_exposition_lines — `ceph_pg_total` is deliberately ABSENT:
+# the exporter already serves it from pg_summary, and a second
+# emission would be a duplicate family)
+PGMAP_FAMILIES = (
+    "ceph_pg_degraded",
+    "ceph_pg_misplaced",
+    "ceph_pg_unfound",
+    "ceph_pg_state",
+    "ceph_pool_stored_bytes",
+    "ceph_pool_objects",
+)
+# families other exporter paths own; the pgmap renderer must never
+# emit them (cross-set collision = duplicate HELP/TYPE in /metrics)
+PGMAP_RESERVED = ("ceph_pg_total", "ceph_pool_pg_num")
+
+
+def product_pgmap_exposition() -> list[str]:
+    """Render the pgmap + progress families through the REAL
+    renderer (mgr/pgmap.py pgmap_exposition_lines) from a synthetic
+    digest and lint the text: every family present exactly once with
+    a HELP/TYPE pair, parseable samples, label-safe values, and no
+    collision with the families the exporter serves elsewhere."""
+    from ceph_tpu.mgr.pgmap import pgmap_exposition_lines
+
+    digest = {
+        "totals": {
+            "objects": 24, "bytes": 49152, "degraded": 3,
+            "misplaced": 1, "unfound": 0,
+        },
+        "pg_states": {"active+clean": 7, "active+degraded": 1},
+        "pools": {
+            1: {"name": "da\"ta", "objects": 24, "bytes": 49152},
+            2: {"name": "rbd", "objects": 0, "bytes": 0},
+        },
+    }
+    text = "\n".join(pgmap_exposition_lines(digest)) + "\n"
+    errors: list[str] = []
+    helped: dict[str, int] = {}
+    typed: dict[str, str] = {}
+    sampled: set[str] = set()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            fam = line.split()[2]
+            helped[fam] = helped.get(fam, 0) + 1
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            typed[parts[2]] = parts[3] if len(parts) > 3 else ""
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(
+                f"pgmap line {lineno}: unparseable sample {line!r}"
+            )
+            continue
+        sampled.add(m.group("name"))
+        try:
+            float(m.group("value"))
+        except ValueError:
+            errors.append(
+                f"pgmap line {lineno}: non-numeric value "
+                f"{m.group('value')!r}"
+            )
+        raw = m.group("labels") or ""
+        pos = 0
+        while pos < len(raw):
+            lm = _LABEL_PAIR_RE.match(raw, pos)
+            if lm is None:
+                errors.append(
+                    f"pgmap line {lineno}: bad label syntax {raw!r}"
+                )
+                break
+            if not _LABEL_NAME_RE.match(lm.group("k")):
+                errors.append(
+                    f"pgmap line {lineno}: bad label name "
+                    f"{lm.group('k')!r}"
+                )
+            pos = lm.end()
+    for fam in PGMAP_FAMILIES:
+        if fam not in sampled:
+            errors.append(f"pgmap family {fam} emitted no samples")
+        if helped.get(fam, 0) != 1:
+            errors.append(
+                f"pgmap family {fam}: {helped.get(fam, 0)} HELP "
+                "headers (want exactly 1)"
+            )
+        if typed.get(fam) != "gauge":
+            errors.append(
+                f"pgmap family {fam}: TYPE {typed.get(fam)!r} "
+                "(want gauge)"
+            )
+    for fam in PGMAP_RESERVED:
+        if fam in sampled or fam in typed:
+            errors.append(
+                f"pgmap renderer emits {fam}, which another "
+                "exporter path owns (duplicate family in /metrics)"
+            )
+    return errors
+
+
 def check_perf_counters(pc) -> list[str]:
     """Lint one PerfCounters set; returns human-readable errors."""
     from ceph_tpu.common.perf_counters import PERFCOUNTER_HISTOGRAM
@@ -731,6 +835,7 @@ def check_all(sets=None) -> list[str]:
         errors.extend(check_recovery_counters())
         errors.extend(check_rgw_counters())
         errors.extend(product_histogram_exposition())
+        errors.extend(product_pgmap_exposition())
     return errors
 
 
