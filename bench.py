@@ -399,6 +399,7 @@ def measure_e2e_batched(on_tpu: bool) -> dict:
     marker — it degrades, never rc != 0.  Batched-vs-per-op outputs
     are gated byte-identical here AND in tests/test_residency.py.
     """
+    from ceph_tpu.ops.profiler import breakdown, dispatch_profiler
     from ceph_tpu.ops.residency import residency_cache
     from ceph_tpu.osd.ec_pg import ECCodec
     from ceph_tpu.store.ec_store import ECStore
@@ -433,6 +434,9 @@ def measure_e2e_batched(on_tpu: bool) -> dict:
     batch_sizes = [1, 2, 4, 8, 16, 32]
     rounds = 3
     sweep = []
+    # flight-recorder attribution for everything measured below (the
+    # warm-up/probe dispatches above are excluded on purpose)
+    disp_before = dispatch_profiler().totals()
     best = (0.0, 1)
     per_op_lats: dict[int, list[float]] = {}
     for b in batch_sizes:
@@ -489,13 +493,23 @@ def measure_e2e_batched(on_tpu: bool) -> dict:
     misses = after["misses"] - before["misses"]
     reuse = round(hits / max(hits + misses, 1), 4)
     per_op = sweep[0]["GBps"] if sweep else 0.0
+    # where the device time of the measured work went: the breakdown
+    # keys are contractual — they emit on the tunnel-down CPU path
+    # too (backend=cpu), never regressing to missing keys
+    disp = breakdown(
+        disp_before, dispatch_profiler().totals(),
+        backend="jax-tpu" if on_tpu else "cpu",
+    )
     _log(
         f"e2e batched: best {best[0]:.3f} GB/s at batch={best[1]} "
         f"({best[0] / max(per_op, 1e-9):.1f}x the per-op rate), "
-        f"scrub residency reuse {reuse:.2%}"
+        f"scrub residency reuse {reuse:.2%}, dispatch split "
+        f"T/C/S {disp['transfer_ms']}/{disp['compute_ms']}/"
+        f"{disp['sync_ms']} ms"
     )
     return {
         "e2e_batched": {
+            "dispatch": disp,
             "sweep": sweep,
             "object_bytes": obj_size,
             "rounds": rounds,
@@ -768,8 +782,10 @@ def measure_ec_families(fast: bool = False) -> dict:
     import random as _random
 
     from ceph_tpu.ec import ErasureCodeProfile, registry_instance
+    from ceph_tpu.ops.profiler import breakdown, dispatch_profiler
     from ceph_tpu.tools.ec_benchmark import _decode_exhaustive
 
+    disp_before = dispatch_profiler().totals()
     out = {}
     for tag, plugin, prof, size, erasures, ex_e in EC_FAMILY_CONFIGS:
         if fast:
@@ -879,6 +895,10 @@ def measure_ec_families(fast: bool = False) -> dict:
             entry["repair_helpers"] = len(spec)
         _log(f"ec family {tag}: {entry}")
         out[tag] = entry
+    out["dispatch"] = breakdown(
+        disp_before, dispatch_profiler().totals(),
+        backend="jax-tpu" if _backend() == "tpu" else "cpu",
+    )
     return out
 
 CRUSH_OSDS = 10_000
@@ -1075,12 +1095,36 @@ def measure_crush() -> dict:
         f"that transfer costs milliseconds and e2e approaches the "
         f"headline"
     )
+    # mapping-plane attribution: one PRODUCT OSDMapMapping pass over
+    # this same hierarchy (the flight recorder's "crush" kind —
+    # jaxmap calls above bypass it by design; _crush_stage is the
+    # instrumented seam).  Non-pow2 pg_num so the lane-0 pad shows.
+    from ceph_tpu.ops.profiler import breakdown, dispatch_profiler
+    from ceph_tpu.osd import OSDMap, OSDMapMapping, PgPool
+
+    om = OSDMap.build(m, CRUSH_OSDS)
+    om.add_pool(PgPool(
+        pool_id=1, size=CRUSH_REP, pg_num=3000, crush_rule=rule
+    ))
+    disp_before = dispatch_profiler().totals()
+    OSDMapMapping().update(om, use_device=True)
+    crush_disp = breakdown(
+        disp_before, dispatch_profiler().totals(),
+        backend="jax-tpu" if _backend() == "tpu" else "cpu",
+    )
+    _log(
+        f"crush mapping-plane dispatch split T/C/S "
+        f"{crush_disp['transfer_ms']}/{crush_disp['compute_ms']}/"
+        f"{crush_disp['sync_ms']} ms, pad waste "
+        f"{crush_disp['pad_waste_ratio']:.2%}"
+    )
     out = {
         "crush_mappings_per_sec": round(dev_rate),
         "crush_e2e_mappings_per_sec": round(e2e_rate),
         "crush_compile_sec": round(compile_s, 1),
         "crush_remap_cached_sec": round(recompile_s, 2),
         "crush_oracle_mappings_per_sec": round(oracle_rate),
+        "crush_dispatch": crush_disp,
     }
     if c_rate is not None:
         out["crush_c_mappings_per_sec"] = round(c_rate)
@@ -1554,6 +1598,12 @@ def measure_recovery(on_tpu: bool) -> dict:
         for nm in names:
             ecs.lose_shard(nm, dead)
 
+    # flight-recorder attribution for the measured rebuilds below
+    # (the identity-gate probe above is excluded on purpose)
+    from ceph_tpu.ops.profiler import breakdown, dispatch_profiler
+
+    disp_before = dispatch_profiler().totals()
+
     # per-op rebuild (the pre-batching regime: one decode per object)
     lose_all()
     t0 = time.perf_counter()
@@ -1599,8 +1649,15 @@ def measure_recovery(on_tpu: bool) -> dict:
         f"{len(lnames) * obj_size / lrc_dt / 2**30:.3f} GB/s"
     )
 
+    # where the rebuilds' device time went (contractual keys — emit
+    # as backend=cpu zeros/host walls on a tunnel-down mount too)
+    disp = breakdown(
+        disp_before, dispatch_profiler().totals(),
+        backend="jax-tpu" if on_tpu else "cpu",
+    )
     out = {
         "recovery": {
+            "dispatch": disp,
             "profile": f"k{K}m{M}",
             "objects": nobj,
             "object_bytes": obj_size,

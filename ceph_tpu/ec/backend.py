@@ -65,10 +65,20 @@ class NumpyBackend:
         """Coalesced-encode seam (the jax backend double-buffers
         device transfers here); the oracle just loops — coalescing is
         a dispatch-cost optimization, and the oracle has no dispatch
-        cost to amortize."""
-        return [
-            self.matrix_stripes(matrix, s, w) for s in stripe_batches
-        ]
+        cost to amortize.  Still records a flight-recorder host entry
+        so the dispatch plane stays populated deviceless."""
+        from ..ops.profiler import dispatch_profiler
+
+        batches = list(stripe_batches)
+        with dispatch_profiler().dispatch(
+            "ec_encode", backend=self.name
+        ) as dp:
+            dp.set_ops(len(batches))
+            dp.set_stripes(sum(s.shape[0] for s in batches))
+            dp.add_bytes_in(sum(s.nbytes for s in batches))
+            return [
+                self.matrix_stripes(matrix, s, w) for s in batches
+            ]
 
     def decode_stripes_batch(
         self, matrix: np.ndarray, row_sets, w: int, chunk: int
@@ -82,14 +92,24 @@ class NumpyBackend:
         matrix.  The oracle loops — it has no dispatch cost to
         amortize — through the same C region-MAC fast path the
         encode side uses."""
-        outs: list[np.ndarray] = []
-        for rows in row_sets:
-            arr = np.stack(
-                [_host_row(r).reshape(-1, chunk) for r in rows],
-                axis=1,
+        from ..ops.profiler import dispatch_profiler
+
+        with dispatch_profiler().dispatch(
+            "ec_decode", backend=self.name
+        ) as dp:
+            dp.set_ops(len(row_sets))
+            dp.add_bytes_in(
+                sum(len(r) for rows in row_sets for r in rows)
             )
-            outs.append(self.matrix_stripes(matrix, arr, w))
-        return outs
+            outs: list[np.ndarray] = []
+            for rows in row_sets:
+                arr = np.stack(
+                    [_host_row(r).reshape(-1, chunk) for r in rows],
+                    axis=1,
+                )
+                outs.append(self.matrix_stripes(matrix, arr, w))
+            dp.set_stripes(sum(o.shape[0] for o in outs))
+            return outs
 
     def bitmatrix_regions(
         self,

@@ -144,19 +144,34 @@ def encode(
             coding = backend.matrix_stripes(matrix, stripes, ec.w)
             out = _assemble_shards(stripes, coding, k, n, want)
         else:
-            parts = {i: [] for i in range(n)}
-            for s in range(nstripes):
-                stripe = buf[
-                    s * sinfo.stripe_width : (s + 1) * sinfo.stripe_width
-                ]
-                encoded = ec.encode(set(range(n)), stripe)
-                for i, chunk in encoded.items():
-                    parts[i].append(chunk)
-            out = {
-                i: np.concatenate(p)
-                for i, p in parts.items()
-                if i in want
-            }
+            # layered/bitmatrix per-stripe loop: one host-path
+            # flight-recorder entry for the whole object (the inner
+            # ec.encode calls record nothing themselves)
+            from ..ops.profiler import dispatch_profiler
+
+            bname = (
+                getattr(getattr(ec, "backend", None), "name", None)
+                or "cpu"
+            )
+            with dispatch_profiler().dispatch(
+                "ec_encode", backend=bname
+            ) as dp:
+                dp.set_ops(1)
+                dp.set_stripes(nstripes)
+                dp.add_bytes_in(buf.nbytes)
+                parts = {i: [] for i in range(n)}
+                for s in range(nstripes):
+                    stripe = buf[
+                        s * sinfo.stripe_width : (s + 1) * sinfo.stripe_width
+                    ]
+                    encoded = ec.encode(set(range(n)), stripe)
+                    for i, chunk in encoded.items():
+                        parts[i].append(chunk)
+                out = {
+                    i: np.concatenate(p)
+                    for i, p in parts.items()
+                    if i in want
+                }
         kt.bytes_out = sum(v.nbytes for v in out.values())
         return out
 
@@ -395,17 +410,29 @@ def decode_batch(
                 # never drops or corrupts an object
                 batched = False
         if not batched:
-            for i in idxs:
-                with ks.timed(
-                    "ec_decode",
-                    bytes_in=sum(
+            # per-object repair loop: one host-path flight-recorder
+            # entry per degraded group (the inner ec._decode calls
+            # record nothing themselves)
+            from ..ops.profiler import dispatch_profiler
+
+            bname = (
+                getattr(getattr(ec, "backend", None), "name", None)
+                or "cpu"
+            )
+            with dispatch_profiler().dispatch(
+                "ec_decode", backend=bname
+            ) as dp:
+                dp.set_ops(len(idxs))
+                for i in idxs:
+                    nbytes = sum(
                         len(v) for v in shard_sets[i].values()
-                    ),
-                ) as kt:
-                    out[i] = _decode_one(ec, shard_sets[i], want)
-                    kt.bytes_out = sum(
-                        len(v) for v in out[i].values()
                     )
+                    dp.add_bytes_in(nbytes)
+                    with ks.timed("ec_decode", bytes_in=nbytes) as kt:
+                        out[i] = _decode_one(ec, shard_sets[i], want)
+                        kt.bytes_out = sum(
+                            len(v) for v in out[i].values()
+                        )
     return out
 
 

@@ -22,6 +22,7 @@ from .gf_matmul import (
     matrix_to_device_bitmatrix,
 )
 from .kernel_stats import kernel_stats
+from .profiler import dispatch_profiler, record_pad
 
 
 def _on_tpu() -> bool:
@@ -118,7 +119,12 @@ class JaxBackend:
         b, _k, chunk = stripes.shape
         with kernel_stats().timed(
             "gf_matmul", bytes_in=stripes.nbytes
-        ) as kt:
+        ) as kt, dispatch_profiler().dispatch(
+            "ec_encode", backend=self.name
+        ) as dp:
+            dp.set_ops(1)
+            dp.set_stripes(b)
+            dp.add_bytes_in(stripes.nbytes)
             # batch axis sharded across the device mesh when >1 device
             # exists and the batch is worth splitting — byte-identical
             # per-stripe math, just spread over chips (ops/mesh.py).
@@ -129,21 +135,35 @@ class JaxBackend:
             dmesh = mesh.default_mesh()
             if dmesh is not None and b >= dmesh.n:
                 bm = matrix_to_device_bitmatrix(matrix, w)
-                out = mesh.sharded_matrix_stripes(bm, stripes, w, dmesh)
+                dp.add_upload(stripes.nbytes)
+                # upload/compute/sync all live inside the sharded
+                # helper; attribute its wall to compute
+                with dp.stage("compute"):
+                    out = mesh.sharded_matrix_stripes(
+                        bm, stripes, w, dmesh
+                    )
                 kt.bytes_out = out.nbytes
                 return out
             if w == 8 and _on_tpu() and (b * chunk) % 4 == 0:
                 bm_np, ok = _host_bm(matrix, w)
                 if ok:
-                    out = np.asarray(
-                        packed_gf.packed_matrix_stripes(bm_np, stripes)
-                    )
+                    dp.add_upload(stripes.nbytes)
+                    with dp.stage("compute"):
+                        out = np.asarray(
+                            packed_gf.packed_matrix_stripes(
+                                bm_np, stripes
+                            )
+                        )
                     kt.bytes_out = out.nbytes
                     return out
             bm = matrix_to_device_bitmatrix(matrix, w)
-            out = np.asarray(
-                self._bitplane_call(bm, stripes, w)
-            )[:b]
+            with dp.stage("upload"):
+                dev = jnp.asarray(stripes)
+            dp.add_upload(stripes.nbytes)
+            with dp.stage("compute"):
+                odev = self._bitplane_dispatch(bm, dev, w)
+            with dp.stage("sync"):
+                out = np.asarray(odev)[:b]
             kt.bytes_out = out.nbytes
             return out
 
@@ -185,7 +205,14 @@ class JaxBackend:
             # profile): encode per batch, still correct
             return [self.matrix_stripes(matrix, s, w) for s in batches]
         total = sum(s.nbytes for s in batches)
-        with kernel_stats().timed("gf_matmul", bytes_in=total) as kt:
+        with kernel_stats().timed(
+            "gf_matmul", bytes_in=total
+        ) as kt, dispatch_profiler().dispatch(
+            "ec_encode", backend=self.name
+        ) as dp:
+            dp.set_ops(len(batches))
+            dp.set_stripes(sum(s.shape[0] for s in batches))
+            dp.add_bytes_in(total)
             bm = matrix_to_device_bitmatrix(matrix, w)
             groups: list[list[np.ndarray]] = []
             cur: list[np.ndarray] = []
@@ -207,12 +234,16 @@ class JaxBackend:
                 )
                 # device_put is async: the transfer overlaps whatever
                 # compute is already dispatched
-                return jax.device_put(arr), arr.shape[0]
+                with dp.stage("upload"):
+                    dev = jax.device_put(arr)
+                dp.add_upload(arr.nbytes)
+                return dev, arr.shape[0]
 
             dev, nb = upload(groups[0])
             pending: list[tuple] = []
             for j in range(len(groups)):
-                out = self._bitplane_dispatch(bm, dev, w)
+                with dp.stage("compute"):
+                    out = self._bitplane_dispatch(bm, dev, w)
                 pending.append((out, nb))
                 if j + 1 < len(groups):
                     # next group's transfer overlaps this group's
@@ -220,7 +251,8 @@ class JaxBackend:
                     dev, nb = upload(groups[j + 1])
             # sync ONLY here (the commit): every dispatched transfer
             # and encode drains together
-            mats = [np.asarray(o)[:b] for o, b in pending]
+            with dp.stage("sync"):
+                mats = [np.asarray(o)[:b] for o, b in pending]
             kt.bytes_out = sum(m.nbytes for m in mats)
         outs: list[np.ndarray] = []
         gi = 0
@@ -259,13 +291,29 @@ class JaxBackend:
         from .residency import is_device_buf
 
         total = sum(len(r) for rows in row_sets for r in rows)
-        with kernel_stats().timed("gf_matmul", bytes_in=total) as kt:
+        with kernel_stats().timed(
+            "gf_matmul", bytes_in=total
+        ) as kt, dispatch_profiler().dispatch(
+            "ec_decode", backend=self.name
+        ) as dp:
+            dp.set_ops(len(row_sets))
+            dp.add_bytes_in(total)
             bm = matrix_to_device_bitmatrix(matrix, w)
             outs: list = [None] * len(row_sets)
             host_idx: list[int] = []
             pending: dict[int, tuple] = {}
             for i, rows in enumerate(row_sets):
                 if any(is_device_buf(r) for r in rows):
+                    # already-resident survivors ride with zero link
+                    # cost; a lazy (unregistered-yet) DeviceBuf's
+                    # device() upload is a real transfer
+                    for r in rows:
+                        if is_device_buf(r):
+                            (
+                                dp.add_resident
+                                if r.resident
+                                else dp.add_upload
+                            )(len(r))
                     # ONE device_put for the object's host rows (a
                     # single resident survivor must not force the
                     # rest row-by-row — the PR 10 _gather_rows
@@ -276,35 +324,40 @@ class JaxBackend:
                         for j, r in enumerate(rows)
                         if not is_device_buf(r)
                     ]
-                    blk = (
-                        jax.device_put(
-                            np.stack(
-                                [
-                                    _row_u8(rows[j]).reshape(
-                                        -1, chunk
-                                    )
-                                    for j in host_js
-                                ]
-                            )
+                    stacked = (
+                        np.stack(
+                            [
+                                _row_u8(rows[j]).reshape(-1, chunk)
+                                for j in host_js
+                            ]
                         )
                         if host_js
                         else None
                     )
-                    hi = 0
-                    devs = []
-                    for j, r in enumerate(rows):
-                        if is_device_buf(r):
-                            devs.append(
-                                r.device().reshape(-1, chunk)
-                            )
-                        else:
-                            devs.append(blk[hi])
-                            hi += 1
-                    dev = jnp.stack(devs, axis=1)
-                    pending[i] = (
-                        self._bitplane_dispatch(bm, dev, w),
-                        dev.shape[0],
-                    )
+                    if stacked is not None:
+                        dp.add_upload(stacked.nbytes)
+                    with dp.stage("upload"):
+                        blk = (
+                            jax.device_put(stacked)
+                            if stacked is not None
+                            else None
+                        )
+                        hi = 0
+                        devs = []
+                        for j, r in enumerate(rows):
+                            if is_device_buf(r):
+                                devs.append(
+                                    r.device().reshape(-1, chunk)
+                                )
+                            else:
+                                devs.append(blk[hi])
+                                hi += 1
+                        dev = jnp.stack(devs, axis=1)
+                    with dp.stage("compute"):
+                        pending[i] = (
+                            self._bitplane_dispatch(bm, dev, w),
+                            dev.shape[0],
+                        )
                 else:
                     host_idx.append(i)
             arrays = {
@@ -338,13 +391,19 @@ class JaxBackend:
                 )
                 # async transfer: overlaps the already-dispatched
                 # decode of the previous group — the double buffer
-                return jax.device_put(arr)
+                with dp.stage("upload"):
+                    dev = jax.device_put(arr)
+                dp.add_upload(arr.nbytes)
+                return dev
 
             gouts = []
             if groups:
                 dev = upload(groups[0])
                 for j in range(len(groups)):
-                    gouts.append(self._bitplane_dispatch(bm, dev, w))
+                    with dp.stage("compute"):
+                        gouts.append(
+                            self._bitplane_dispatch(bm, dev, w)
+                        )
                     if j + 1 < len(groups):
                         dev = upload(groups[j + 1])
             for j, group in enumerate(groups):
@@ -356,9 +415,14 @@ class JaxBackend:
                     off += b
             for i, (mat, b) in pending.items():
                 outs[i] = mat[:b]
+            dp.set_stripes(
+                sum(b for _, b in pending.values())
+                + sum(arrays[i].shape[0] for i in host_idx)
+            )
             # sync ONLY here (the commit point); results STAY on
             # device for device-born registration downstream
-            outs = [jax.block_until_ready(o) for o in outs]
+            with dp.stage("sync"):
+                outs = [jax.block_until_ready(o) for o in outs]
             kt.bytes_out = sum(int(np.prod(o.shape)) for o in outs)
         return outs
 
@@ -377,6 +441,7 @@ class JaxBackend:
         bb = bucket_pow2(b)
         if bb != b:
             dev = jnp.pad(dev, ((0, bb - b), (0, 0), (0, 0)))
+            record_pad((bb - b) * k * chunk)
         note_shape("ec_stripes", bb, k, chunk, w)
         return gf_matrix_stripes(bm, dev, w=w)
 

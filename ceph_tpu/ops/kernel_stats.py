@@ -61,6 +61,14 @@ class KernelStats:
                              "device bitmatrix/table cache hits")
         self._ensure_counter("l_tpu_compile_cache_miss", PERFCOUNTER_U64,
                              "device bitmatrix/table cache misses")
+        # pow2 shape bucketing buys compile-cache hits by padding:
+        # the EC batch-axis zero pad, the CRUSH lane-0 repeat, the
+        # crc filler rows.  This counts those device-visible bytes so
+        # the trade stops being invisible.
+        self._ensure_counter(
+            "l_tpu_pad_bytes_wasted", PERFCOUNTER_U64,
+            "device bytes padded in by pow2 shape bucketing"
+        )
 
     def _ensure_counter(
         self, name: str, kind: str, desc: str, bounds: tuple = ()
@@ -139,12 +147,18 @@ class KernelStats:
             )
         return out
 
+    def record_pad(self, nbytes: int) -> None:
+        """Count shape-bucketing pad bytes (device-visible bytes that
+        carry no payload)."""
+        if nbytes:
+            self.perf.inc("l_tpu_pad_bytes_wasted", int(nbytes))
+
     def counter(self, group: str, suffix: str, kind=PERFCOUNTER_U64,
-                desc: str = ""):
+                desc: str = "", bounds: tuple = ()):
         """Register an extra per-group counter (e.g. crush's
         l_tpu_crush_pgs) and return its full name."""
         name = f"l_tpu_{group}_{suffix}"
-        self._ensure_counter(name, kind, desc)
+        self._ensure_counter(name, kind, desc, bounds=bounds)
         return name
 
     def timed(self, group: str, bytes_in: int = 0):

@@ -368,6 +368,11 @@ def note_shape(site: str, *shape) -> bool:
         if not hit:
             _seen_shapes.add(key)
     kernel_stats().record_cache(int(hit), int(not hit))
+    # attach the event to the active flight-recorder dispatch (the
+    # global counters above are the source of truth)
+    from .profiler import record_compile
+
+    record_compile(hit)
     return hit
 
 

@@ -267,6 +267,18 @@ def _gather_rows(entries, width: int, *, align_right: bool, fillers: int = 0):
     res_idx = [
         i for i, e in enumerate(entries) if isinstance(e, DeviceBuf)
     ]
+    # flight-recorder byte attribution: host rows cross the link this
+    # dispatch; registered-resident tokens are served where they live
+    # (a lazy unregistered DeviceBuf's device() upload is a transfer)
+    from .profiler import record_resident, record_upload
+
+    record_upload(sum(len(entries[i]) for i in host_idx))
+    for i in res_idx:
+        (
+            record_resident
+            if entries[i].resident
+            else record_upload
+        )(len(entries[i]))
     block = np.zeros((len(host_idx) + fillers, width), dtype=np.uint8)
     for r, i in enumerate(host_idx):
         raw = bytes(entries[i])
@@ -305,15 +317,21 @@ def _gather_rows(entries, width: int, *, align_right: bool, fillers: int = 0):
 
 
 def _oracle(buffers, inits) -> np.ndarray:
+    from .profiler import dispatch_profiler
     from .residency import as_host_bytes
 
-    return np.array(
-        [
-            ceph_crc32c(init, as_host_bytes(buf))
-            for buf, init in zip(buffers, inits)
-        ],
-        dtype=np.uint32,
-    )
+    with dispatch_profiler().dispatch(
+        "crc32c", backend="cpu"
+    ) as dp:
+        dp.set_ops(len(buffers))
+        dp.add_bytes_in(sum(len(b) for b in buffers))
+        return np.array(
+            [
+                ceph_crc32c(init, as_host_bytes(buf))
+                for buf, init in zip(buffers, inits)
+            ],
+            dtype=np.uint32,
+        )
 
 
 def batch_crc32c(
@@ -349,6 +367,7 @@ def batch_crc32c(
 
 
 def _device_crc32c(buffers, inits) -> np.ndarray:
+    from .profiler import dispatch_profiler
     from .residency import bucket_pow2, note_shape
 
     _self_check()
@@ -358,7 +377,16 @@ def _device_crc32c(buffers, inits) -> np.ndarray:
     nchunks = padded // _CHUNK
     nrows = bucket_pow2(n)
     ks = _kstats()
-    with ks.timed("scrub_crc32c", bytes_in=sum(lens)) as kt:
+    with ks.timed(
+        "scrub_crc32c", bytes_in=sum(lens)
+    ) as kt, dispatch_profiler().dispatch(
+        "crc32c", backend="jax"
+    ) as dp:
+        dp.set_ops(n)
+        dp.add_bytes_in(sum(lens))
+        # right-align zeros + pow2 filler rows: device-visible bytes
+        # the shape bucket padded in
+        dp.add_pad(padded * nrows - sum(lens))
         gc = ks.counted_cache_call(_device_chunk_matrix, _CHUNK)
         hc = ks.counted_cache_call(
             _device_combine_matrix, _CHUNK, nchunks
@@ -368,10 +396,14 @@ def _device_crc32c(buffers, inits) -> np.ndarray:
         # resident payloads right-align ON DEVICE (no second
         # host→device transfer); host payloads + the pow2 filler rows
         # (which crc to 0 and slice away) ride ONE bulk device_put
-        rows = _gather_rows(
-            buffers, padded, align_right=True, fillers=nrows - n
-        ).reshape(nrows, nchunks, _CHUNK)
-        out = np.asarray(call(rows, gc, hc)).astype(np.uint32)[:n]
+        with dp.stage("upload"):
+            rows = _gather_rows(
+                buffers, padded, align_right=True, fillers=nrows - n
+            ).reshape(nrows, nchunks, _CHUNK)
+        with dp.stage("compute"):
+            res = call(rows, gc, hc)
+        with dp.stage("sync"):
+            out = np.asarray(res).astype(np.uint32)[:n]
         kt.bytes_out = out.nbytes
     # per-object init fold: crc = data_term ⊕ L^len(init)
     for i, (ln, init) in enumerate(zip(lens, inits)):
@@ -431,30 +463,48 @@ def batch_compare(stored, expected, *, backend: str | None = None):
             rows[row, : len(raw)] = np.frombuffer(raw, dtype=np.uint8)
         return rows
 
+    from .profiler import dispatch_profiler
+
+    total = sum(
+        len(stored[i]) + len(expected[i]) for i in same_len
+    )
     if backend != "oracle":
         try:
             ks = _kstats()
-            total = sum(2 * len(stored[i]) for i in same_len)
-            with ks.timed("scrub_verify", bytes_in=total) as kt:
-                a_dev = _gather_rows(
-                    [stored[i] for i in same_len], bwidth,
-                    align_right=False,
-                )
-                b_dev = _gather_rows(
-                    [expected[i] for i in same_len], bwidth,
-                    align_right=False,
-                )
+            with ks.timed(
+                "scrub_verify", bytes_in=total
+            ) as kt, dispatch_profiler().dispatch(
+                "compare", backend="jax"
+            ) as dp:
+                dp.set_ops(len(same_len))
+                dp.add_bytes_in(total)
+                dp.add_pad(2 * bwidth * len(same_len) - total)
+                with dp.stage("upload"):
+                    a_dev = _gather_rows(
+                        [stored[i] for i in same_len], bwidth,
+                        align_right=False,
+                    )
+                    b_dev = _gather_rows(
+                        [expected[i] for i in same_len], bwidth,
+                        align_right=False,
+                    )
                 note_shape("scrub_verify", len(same_len), bwidth)
-                verdict = np.asarray(
-                    _compare_call(bwidth)(a_dev, b_dev)
-                )
+                with dp.stage("compute"):
+                    vdev = _compare_call(bwidth)(a_dev, b_dev)
+                with dp.stage("sync"):
+                    verdict = np.asarray(vdev)
                 kt.bytes_out = verdict.nbytes
             out[same_len] = verdict
             return out
         except Exception:  # noqa: BLE001 — fall through to numpy
             if backend == "device":
                 raise
-    a = _host_rows(stored)
-    b = _host_rows(expected)
-    out[same_len] = (a != b).any(axis=1)
+    with dispatch_profiler().dispatch(
+        "compare", backend="cpu"
+    ) as dp:
+        dp.set_ops(len(same_len))
+        dp.add_bytes_in(total)
+        a = _host_rows(stored)
+        b = _host_rows(expected)
+        out[same_len] = (a != b).any(axis=1)
     return out

@@ -513,6 +513,21 @@ class OSD(Dispatcher):
                 lambda args: self.dump_backoffs(),
                 "dump client backoffs this OSD holds",
             )
+            # device-dispatch flight recorder (ops/profiler.py): the
+            # raw ring and the per-kind rollup — process-global, like
+            # the kernel counters above
+            self.admin.register_command(
+                "dispatch history",
+                lambda args: self._dispatch_history(args),
+                "raw device-dispatch flight-recorder ring "
+                "(kind=<k> limit=<n> filter)",
+            )
+            self.admin.register_command(
+                "dispatch summary",
+                lambda args: self._dispatch_summary(args),
+                "per-kind device-dispatch rollup "
+                "(time split, occupancy, residency)",
+            )
             self.admin.start()
         self._shard_server = ShardServer(
             self.store, whoami,
@@ -3423,6 +3438,31 @@ class OSD(Dispatcher):
         finally:
             self._stat_report_inflight = False
 
+    def _dispatch_history(self, args: dict) -> dict:
+        """`dispatch history` (tell + admin socket): the raw
+        flight-recorder ring — process-global, like the kernel
+        counters it feeds."""
+        from ..ops.profiler import dispatch_profiler
+
+        try:
+            limit = int(args.get("limit", 0) or 0)
+        except (TypeError, ValueError):
+            limit = 0
+        return dispatch_profiler().history(
+            kind=str(args.get("kind", "") or "") or None,
+            limit=limit,
+        )
+
+    def _dispatch_summary(self, args: dict) -> dict:
+        """`dispatch summary` (tell + admin socket): per-kind
+        rollup with the derived time-split/occupancy/residency
+        ratios."""
+        from ..ops.profiler import dispatch_profiler
+
+        return dispatch_profiler().summary(
+            kind=str(args.get("kind", "") or "") or None
+        )
+
     def _handle_tell(self, conn: Connection, msg: MCommand) -> None:
         """`ceph tell osd.N ...` service (MCommand): the fault-plane
         commands and dump_backoffs, answered inline."""
@@ -3467,6 +3507,10 @@ class OSD(Dispatcher):
                         str(cmd.get("qos_class", "")),
                     )
                 )
+            elif prefix == "dispatch history":
+                reply.outb = json.dumps(self._dispatch_history(cmd))
+            elif prefix == "dispatch summary":
+                reply.outb = json.dumps(self._dispatch_summary(cmd))
             else:
                 reply.rc = -22
                 reply.outs = f"unknown tell command {prefix!r}"

@@ -164,41 +164,69 @@ class OSDMapMapping:
         if use_device:
             try:
                 from ..crush import jaxmap
+                from ..ops.profiler import dispatch_profiler
                 from ..ops.residency import bucket_pow2, note_shape
                 from .sharded_mapping import mesh_batch_do_rule
 
                 cm = _compiled(osdmap.crush)
-                # bucket the PG batch to a power of two (pad with a
-                # repeat of lane 0 — a valid input — and slice the
-                # rows back) so pools with ragged pg_num and remap
-                # sweeps replay ONE compiled program per bucket;
-                # reuse lands in l_tpu_compile_cache_{hit,miss}
-                nb = bucket_pow2(n)
-                pps_in = pps
-                if nb != n:
-                    pps_in = np.concatenate(
-                        [pps, np.full(nb - n, pps[0], dtype=pps.dtype)]
+                # an UnsupportedMap raised anywhere in here discards
+                # the flight-recorder entry (no commit on exception —
+                # the oracle loop below records its own)
+                with dispatch_profiler().dispatch(
+                    "crush", backend="jax"
+                ) as dp:
+                    dp.set_ops(1)
+                    dp.set_stripes(n)
+                    dp.add_bytes_in(pps.nbytes)
+                    dp.add_upload(pps.nbytes)
+                    # bucket the PG batch to a power of two (pad with
+                    # a repeat of lane 0 — a valid input — and slice
+                    # the rows back) so pools with ragged pg_num and
+                    # remap sweeps replay ONE compiled program per
+                    # bucket; reuse lands in
+                    # l_tpu_compile_cache_{hit,miss}
+                    nb = bucket_pow2(n)
+                    pps_in = pps
+                    if nb != n:
+                        pps_in = np.concatenate(
+                            [pps, np.full(nb - n, pps[0], dtype=pps.dtype)]
+                        )
+                        dp.add_pad((nb - n) * pps.itemsize)
+                    note_shape("crush_batch", nb, pool.size)
+                    # shards across the device mesh when >1 device
+                    # exists (ParallelPGMapper role); single-device
+                    # unchanged
+                    with dp.stage("compute"):
+                        res, counts = mesh_batch_do_rule(
+                            cm, ruleno, pps_in, pool.size,
+                            osdmap.osd_weight,
+                        )
+                    with dp.stage("sync"):
+                        raw = np.asarray(res, dtype=np.int64)[:n]
+                        counts = np.asarray(counts)[:n]
+                    # positions beyond the returned count are absent,
+                    # not NONE
+                    cols = np.arange(pool.size)
+                    return np.where(
+                        cols[None, :] < counts[:, None], raw, _NONE
                     )
-                note_shape("crush_batch", nb, pool.size)
-                # shards across the device mesh when >1 device exists
-                # (ParallelPGMapper role); single-device unchanged
-                res, counts = mesh_batch_do_rule(
-                    cm, ruleno, pps_in, pool.size, osdmap.osd_weight
-                )
-                raw = np.asarray(res, dtype=np.int64)[:n]
-                counts = np.asarray(counts)[:n]
-                # positions beyond the returned count are absent, not NONE
-                cols = np.arange(pool.size)
-                return np.where(cols[None, :] < counts[:, None], raw, _NONE)
             except jaxmap.UnsupportedMap:
                 pass
-        raw = np.full((n, pool.size), _NONE, dtype=np.int64)
-        for i in range(n):
-            row = osdmap.crush.do_rule(
-                ruleno, int(pps[i]), pool.size, osdmap.osd_weight
-            )
-            raw[i, : len(row)] = row
-        return raw
+        from ..ops.profiler import dispatch_profiler
+
+        with dispatch_profiler().dispatch(
+            "crush", backend="cpu"
+        ) as dp:
+            dp.set_ops(1)
+            dp.set_stripes(n)
+            dp.add_bytes_in(pps.nbytes)
+            raw = np.full((n, pool.size), _NONE, dtype=np.int64)
+            for i in range(n):
+                row = osdmap.crush.do_rule(
+                    ruleno, int(pps[i]), pool.size, osdmap.osd_weight
+                )
+                raw[i, : len(row)] = row
+            return raw
 
     def _upmap_stage(self, osdmap, pool, ps, raw):
         """Sparse dict overrides — handled per-affected-row."""

@@ -103,6 +103,26 @@ RESIDENCY_COUNTERS = (
     "l_tpu_batch_decode_dispatches",
     "l_tpu_batch_decode_ops_per_dispatch",
 )
+# device-dispatch flight-recorder family the kernel-stats schema must
+# declare (ops/profiler.py ensure_dispatch_counters — the
+# transfer/compute/sync attribution plane the bench breakdown and the
+# `dispatch history|summary` tell surface read), plus the pad-waste
+# counter kernel_stats registers at construction
+DISPATCH_COUNTERS = (
+    "l_tpu_dispatch_count",
+    "l_tpu_dispatch_ops",
+    "l_tpu_dispatch_stripes",
+    "l_tpu_dispatch_bytes_uploaded",
+    "l_tpu_dispatch_bytes_resident",
+    "l_tpu_dispatch_ring_dropped",
+    "l_tpu_dispatch_transfer_lat",
+    "l_tpu_dispatch_transfer_lat_hist",
+    "l_tpu_dispatch_compute_lat",
+    "l_tpu_dispatch_compute_lat_hist",
+    "l_tpu_dispatch_sync_lat",
+    "l_tpu_dispatch_sync_lat_hist",
+    "l_tpu_pad_bytes_wasted",
+)
 # sharded bucket-index + reshard families the RGW schema must
 # declare (rgw/index.py build_rgw_perf — the bench rgw_index section
 # and the reshard-under-load tests read exactly these)
@@ -438,6 +458,34 @@ def check_residency_counters() -> list[str]:
     ]
 
 
+def check_dispatch_counters() -> list[str]:
+    """The kernel-stats schema must keep declaring the
+    flight-recorder family through the REAL registration helper
+    (ops/profiler.ensure_dispatch_counters — the exact names the
+    bench dispatch breakdown and the prometheus exporter read), with
+    the stage-latency histograms carrying real bucket bounds."""
+    from ceph_tpu.ops.kernel_stats import KernelStats
+    from ceph_tpu.ops.profiler import ensure_dispatch_counters
+
+    ks = KernelStats()
+    ensure_dispatch_counters(ks)
+    declared = set(ks.perf._counters)
+    errors = [
+        f"kernel schema: dispatch counter {name!r} missing"
+        for name in DISPATCH_COUNTERS
+        if name not in declared
+    ]
+    for stage in ("transfer", "compute", "sync"):
+        name = f"l_tpu_dispatch_{stage}_lat_hist"
+        c = ks.perf._counters.get(name)
+        if c is not None and not getattr(c, "bucket_bounds", ()):
+            errors.append(
+                f"kernel schema: {name} histogram has no bucket "
+                "bounds"
+            )
+    return errors
+
+
 def product_event_samples() -> list[str]:
     """Generate one real clog entry and one real crash report through
     the product code paths and lint them — the schemas daemons
@@ -635,6 +683,31 @@ def product_histogram_exposition() -> list[str]:
             [({"ceph_daemon": "osd.0"}, commit.snapshot())],
         )
     )
+    # a real flight-recorder stage histogram through the same
+    # renderer: commit one dispatch on a private profiler and render
+    # its sync-latency distribution as the exporter would
+    from ceph_tpu.ops.kernel_stats import KernelStats
+    from ceph_tpu.ops.profiler import DispatchProfiler
+
+    dks = KernelStats()
+    dprof = DispatchProfiler(capacity=8, ks=dks)
+    with dprof.dispatch("crc32c", backend="jax") as dp:
+        dp.set_ops(1)
+        with dp.stage("sync"):
+            pass
+    snap = dks.dump().get("l_tpu_dispatch_sync_lat_hist")
+    if not isinstance(snap, dict) or "bounds" not in snap:
+        return [
+            "dispatch sync lat_hist dump is not a histogram "
+            f"snapshot: {snap!r}"
+        ]
+    lines.extend(
+        histogram_exposition_lines(
+            "ceph_daemon_tpu_dispatch_sync_lat_seconds",
+            "device dispatch sync-stage latency",
+            [({"ceph_daemon": "osd.0"}, snap)],
+        )
+    )
     text = "\n".join(lines) + "\n"
     errors = check_prometheus_histograms(text)
     if "le=\"+Inf\"" not in text:
@@ -799,6 +872,10 @@ def product_counter_sets():
     # residency + coalesced-encode families (ops/residency.py) join
     # the schema walk and the cross-set collision lint
     ensure_counters(ks)
+    # flight-recorder family (ops/profiler.py) likewise
+    from ceph_tpu.ops.profiler import ensure_dispatch_counters
+
+    ensure_dispatch_counters(ks)
     return [
         build_osd_perf(0), build_mapping_perf(), ks.perf,
         build_msgr_perf("osd.0"),
@@ -832,6 +909,7 @@ def check_all(sets=None) -> list[str]:
         errors.extend(check_fault_counters())
         errors.extend(check_worker_counters())
         errors.extend(check_residency_counters())
+        errors.extend(check_dispatch_counters())
         errors.extend(check_recovery_counters())
         errors.extend(check_rgw_counters())
         errors.extend(product_histogram_exposition())
