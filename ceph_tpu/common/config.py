@@ -168,6 +168,41 @@ SCHEMA: dict[str, Option] = {
             level=LEVEL_BASIC,
         ),
         Option(
+            "wal_prefer_deferred_size",
+            OPT_INT,
+            65536,
+            "transactions whose write payload is below this ack at "
+            "WAL append and defer the apply to the drain "
+            "(bluestore_prefer_deferred_size, options.cc)",
+            min=0,
+            level=LEVEL_BASIC,
+        ),
+        Option(
+            "wal_max_group_txc",
+            OPT_INT,
+            32,
+            "commit records one group-commit barrier may absorb "
+            "(bluestore_max_deferred_txc analog)",
+            min=1,
+            level=LEVEL_BASIC,
+        ),
+        Option(
+            "wal_flush_interval_ms",
+            OPT_FLOAT,
+            0.5,
+            "how long a group-commit barrier holds for in-flight "
+            "stragglers before syncing; a solo writer never waits",
+            min=0.0,
+        ),
+        Option(
+            "wal_checkpoint_bytes",
+            OPT_INT,
+            8 << 20,
+            "WAL size that triggers a checkpoint + truncation once "
+            "every record is applied (durable inner stores only)",
+            min=1 << 10,
+        ),
+        Option(
             "rgw_max_objs_per_shard",
             OPT_INT,
             100000,

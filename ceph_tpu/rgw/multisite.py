@@ -44,6 +44,10 @@ class SyncAgent:
         self.interval = interval
         self.full_syncs = 0
         self.entries_applied = 0
+        # last swallowed sync_once failure (cleared by the next clean
+        # pass) — the agent survives transient errors, but a stuck
+        # bootstrap must be diagnosable from outside the thread
+        self.last_error: str | None = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name=f"rgw-sync.{zone}", daemon=True
@@ -58,8 +62,9 @@ class SyncAgent:
         while not self._stop.wait(self.interval):
             try:
                 self.sync_once()
-            except Exception:  # noqa: BLE001 — the agent survives
-                pass
+                self.last_error = None
+            except Exception as e:  # noqa: BLE001 — the agent survives
+                self.last_error = f"{type(e).__name__}: {e}"
 
     # -- marker (sync status lives at the DESTINATION) ---------------------
     def _get_marker(self) -> int | None:

@@ -6,13 +6,16 @@
 it — the simplified ECBackend: full-stripe writes through the batched
 encode seam, reconstructing reads, HashInfo scrub, and single-shard
 recovery with minimum reads (src/osd/ECBackend.cc's read/write/
-recovery paths without the messenger hop).
+recovery paths without the messenger hop); ``wal_store`` fronts any
+concrete store with a write-ahead log — group commit, deferred small
+writes, crash replay (the BlueStore deferred-write role).
 """
 
 from .ec_store import ECStore, ScrubResult
 from .blockstore import BlockStore
 from .kstore import KStore
 from .objectstore import MemStore, ObjectStore, Transaction
+from .wal_store import WALStore
 
 __all__ = [
     "BlockStore",
@@ -22,4 +25,5 @@ __all__ = [
     "ObjectStore",
     "ScrubResult",
     "Transaction",
+    "WALStore",
 ]

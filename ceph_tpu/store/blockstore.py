@@ -646,6 +646,10 @@ class BlockStore(ObjectStore):
                 k[len(p):] for k in self.kv.db if k.startswith(p)
             )
 
+    def coll_exists(self, cid: str) -> bool:
+        with self._lock:
+            return _ckey(cid) in self.kv.db
+
     def list_objects(self, cid) -> list[str]:
         with self._lock:
             if _ckey(cid) not in self.kv.db:

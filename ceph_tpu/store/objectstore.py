@@ -256,6 +256,15 @@ class ObjectStore:
     def list_collections(self) -> list[str]:
         raise NotImplementedError
 
+    def coll_exists(self, cid: str) -> bool:
+        """Collection existence (ObjectStore::collection_exists).
+        Concrete stores override with an O(1) probe; the fallback
+        walks the listing."""
+        try:
+            return cid in self.list_collections()
+        except StoreError:
+            return False
+
     def list_attrs(self, cid: str, oid: str) -> dict[str, bytes]:
         raise NotImplementedError
 
@@ -493,6 +502,10 @@ class MemStore(ObjectStore):
     def list_collections(self) -> list[str]:
         with self._lock:
             return sorted(self._colls)
+
+    def coll_exists(self, cid: str) -> bool:
+        with self._lock:
+            return cid in self._colls
 
     def list_attrs(self, cid, oid) -> dict[str, bytes]:
         with self._lock:

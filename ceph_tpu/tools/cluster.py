@@ -106,6 +106,10 @@ class Cluster:
             store = self._store_for(i)
             osd = OSD(
                 i, store=store,
+                wal_dir=(
+                    str(self.dir / f"osd.{i}-wal")
+                    if self.spec.get("wal") else None
+                ),
                 admin_socket_path=str(self.dir / f"osd.{i}.asok"),
                 # big clusters ride the shared network stack's
                 # strands/timers instead of 3 threads per daemon
@@ -232,6 +236,7 @@ def _cmd_start(args) -> int:
         "mds": args.mds,
         "rgw": args.rgw,
         "memstore": args.memstore,
+        "wal": args.wal,
         "mon_port": args.mon_port,
         "rgw_port": args.rgw_port,
         "shared_services": args.shared_services,
@@ -333,6 +338,11 @@ def main(argv=None) -> int:
     sp.add_argument("--rgw", type=int, default=0)
     sp.add_argument("--memstore", action="store_true",
                     help="RAM stores (no persistence)")
+    sp.add_argument(
+        "--wal", action="store_true",
+        help="front each OSD store with the write-ahead log "
+        "(deferred small writes, group commit, crash replay)",
+    )
     sp.add_argument(
         "--shared-services", action="store_true",
         help="OSD tick/report/op-queue on the shared network "

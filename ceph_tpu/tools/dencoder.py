@@ -259,6 +259,43 @@ def _build_types():
 
     types["objectstore_transaction"] = (txn_build, txn_roundtrip)
 
+    # WAL plane (store/wal_store.py): the on-log record (seq + crc
+    # over the transaction payload) and the replay-base checkpoint are
+    # durable formats — a log written by one build must replay under
+    # every later one
+    from ..store.wal_store import (
+        WALCheckpoint,
+        decode_wal_checkpoint,
+        decode_wal_record,
+        encode_wal_checkpoint,
+        encode_wal_record,
+        make_wal_record,
+    )
+
+    def wal_record_build() -> bytes:
+        e = Encoder()
+        encode_wal_record(e, make_wal_record(42, txn_build()))
+        return e.getvalue()
+
+    def wal_record_roundtrip(blob: bytes) -> bytes:
+        e = Encoder()
+        encode_wal_record(e, decode_wal_record(Decoder(blob)))
+        return e.getvalue()
+
+    types["wal_record"] = (wal_record_build, wal_record_roundtrip)
+
+    def wal_ckpt_build() -> bytes:
+        e = Encoder()
+        encode_wal_checkpoint(e, WALCheckpoint(1337))
+        return e.getvalue()
+
+    def wal_ckpt_roundtrip(blob: bytes) -> bytes:
+        e = Encoder()
+        encode_wal_checkpoint(e, decode_wal_checkpoint(Decoder(blob)))
+        return e.getvalue()
+
+    types["wal_checkpoint"] = (wal_ckpt_build, wal_ckpt_roundtrip)
+
     # latency-histogram snapshots (the SLO plane's wire/artifact
     # shapes, common/histogram.py): the 1D log2 histogram and the 2D
     # latency×size grid both pin their binary snapshot encoding

@@ -23,6 +23,10 @@ acceptance criteria, and tears everything down:
   in dump_backoffs on both ends, no resend storm), OSD_FULL raises
   HEALTH_ERR, reads keep serving, FULL_TRY deletes land, and freeing
   space releases the parked ops and clears the check.
+- ``scenario_kill_storm_wal``     SIGKILL a subprocess-hosted
+  WAL-fronted OSD mid small-write storm: PG_DEGRADED raises, the
+  restart replays the log (nonzero replayed records), the check
+  clears, and zero acknowledged writes are lost byte-for-byte.
 
 pytest drives these from tests/test_chaos.py (multi-second scenarios
 carry the ``slow`` marker there); ``python tests/chaos.py [name ...]``
@@ -953,12 +957,238 @@ def scenario_kill_osd_at_fill(seed: int = DEFAULT_SEED) -> dict:
         mon_msgr.shutdown()
 
 
+# the WAL-fronted OSD a SIGKILL can actually reach: a real child
+# process hosting one OSD over WALStore(BlockStore), its drain
+# throttled so a small-write storm leaves a committed-but-unapplied
+# backlog in the log at kill time.  It prints "ready <replayed>"
+# after the WAL mount (so a restart reports how many records crash
+# replay re-applied) and its address port, then boots and parks.
+_WAL_OSD_CHILD = """
+import sys, time
+from ceph_tpu.osd.daemon import OSD
+from ceph_tpu.store import BlockStore
+
+osd_id, host, port, data_dir, wal_dir, drain_delay = sys.argv[1:7]
+osd = OSD(
+    int(osd_id), store=BlockStore(data_dir, sync=False),
+    wal_dir=wal_dir, tick_interval=0.2, heartbeat_grace=1.0,
+)
+osd.store.drain_delay = float(drain_delay)
+print("ready", osd.store.replayed_records, flush=True)
+osd.boot(host, int(port))
+while True:
+    time.sleep(0.5)
+"""
+
+
+def scenario_kill_storm_wal(seed: int = DEFAULT_SEED) -> dict:
+    """The WAL crash gate (ISSUE 18): one OSD of three runs in a REAL
+    child process over WALStore(BlockStore) with a throttled drain,
+    the cluster takes a 4k small-write storm, and the child is
+    SIGKILLed mid-storm with acked-but-unapplied records in its log.
+    The scenario asserts: the kill surfaces through the PR 16
+    observability plane (PG_DEGRADED raises with a nonzero degraded
+    count in `ceph status`), the restarted child REPLAYS the WAL
+    (nonzero replayed records reported from its remount), the cluster
+    heals (PG_DEGRADED clears, degraded drains to zero), and ZERO
+    acknowledged writes are lost — every acked oid reads back
+    byte-identical to the oracle the storm recorded at ack time."""
+    import shutil
+    import signal as _signal
+    import subprocess
+    import tempfile
+
+    from test_ec_daemon import _base_map
+    from ceph_tpu.mgr import Manager
+    from ceph_tpu.mgr.pgmap import PgMapModule
+    from ceph_tpu.mon.monitor import Monitor
+    from ceph_tpu.msg import Messenger
+
+    n = 3
+    victim = 2
+    obj = 4096
+    workdir = tempfile.mkdtemp(prefix="chaos-wal-")
+    mon = Monitor(_base_map(n), min_reporters=2)
+    mon_msgr = Messenger("mon")
+    mon_msgr.add_dispatcher(mon)
+    mon_addr = mon_msgr.bind()
+    mgr = Manager(modules=[PgMapModule], name="chaos")
+    mgr.start(mon_addr)
+    osds: dict[int, OSD] = {}
+    proc = None
+    client = None
+
+    def spawn_victim(drain_delay: float):
+        p = subprocess.Popen(
+            [
+                sys.executable, "-c", _WAL_OSD_CHILD, str(victim),
+                mon_addr[0], str(mon_addr[1]),
+                os.path.join(workdir, "victim-data"),
+                os.path.join(workdir, "victim-wal"),
+                str(drain_delay),
+            ],
+            stdout=subprocess.PIPE, text=True,
+        )
+        line = p.stdout.readline().split()
+        assert line[:1] == ["ready"], f"victim never mounted: {line}"
+        return p, int(line[1])
+
+    try:
+        for i in range(n):
+            if i == victim:
+                continue
+            osd = OSD(i, tick_interval=0.2, heartbeat_grace=1.0)
+            osd.log_keep = 4096  # the storm must stay log-recoverable
+            osd.boot(*mon_addr)
+            osds[i] = osd
+        # the drain throttle guarantees a deferred backlog at kill
+        proc, replayed_at_boot = spawn_victim(drain_delay=0.1)
+        assert replayed_at_boot == 0
+
+        r = Rados("chaos-walstorm")
+        client = r.connect(*mon_addr)
+        client.objecter.op_timeout = 30.0
+        client.pool_create("walstorm", pg_num=4, size=3, min_size=2)
+        io = client.open_ioctx("walstorm")
+        assert wait_for(
+            lambda: client.monc.osdmap.is_up(victim), 15.0
+        ), "victim child never booted into the map"
+
+        # the storm: unique 4k oids, acked oracle recorded AFTER each
+        # ack returns — exactly the set replay must preserve
+        stop = threading.Event()
+        acked: dict[str, bytes] = {}
+        errors: list[str] = []
+        llock = threading.Lock()
+
+        def storm():
+            i = 0
+            while not stop.is_set():
+                oid = f"storm-{i}"
+                data = bytes([1 + i % 255]) * obj
+                try:
+                    io.write_full(oid, data)
+                    with llock:
+                        acked[oid] = data
+                except RadosError as e:
+                    errors.append(str(e))
+                i += 1
+                time.sleep(0.01)
+
+        t = threading.Thread(target=storm, daemon=True)
+        t.start()
+        time.sleep(1.5)  # build a deferred backlog in the victim
+
+        # SIGKILL mid-storm: no close, no flush, no drain
+        proc.send_signal(_signal.SIGKILL)
+        proc.wait(10)
+        proc = None
+        with llock:
+            acked_at_kill = len(acked)
+        assert wait_for(
+            lambda: not client.monc.osdmap.is_up(victim), 20.0
+        ), "mon never marked the killed victim down"
+
+        # PR 16 observability verdict, half one: the kill raises
+        # PG_DEGRADED with a nonzero degraded count in `ceph status`
+        degraded_peak = [0]
+
+        def degraded_visible():
+            rc2, outb, _o = client.mon_command({"prefix": "status"})
+            if rc2 != 0:
+                return False
+            data = json.loads(outb).get("pgmap", {}).get("data", {})
+            degraded_peak[0] = max(
+                degraded_peak[0], int(data.get("degraded", 0))
+            )
+            rc2, outb, _o = client.mon_command({"prefix": "health"})
+            return (
+                rc2 == 0
+                and degraded_peak[0] > 0
+                and "PG_DEGRADED"
+                in json.loads(outb).get("checks_detail", {})
+            )
+
+        assert wait_for(degraded_visible, 20.0), (
+            "PG_DEGRADED never raised after the kill"
+        )
+
+        # let the storm write INTO the degraded window (these acks
+        # land on 2/3 replicas and must survive the heal), then stop
+        time.sleep(1.0)
+        stop.set()
+        t.join(timeout=20)
+        assert acked, "storm acked nothing"
+
+        # restart: same data dir, same WAL dir — the remount IS the
+        # crash recovery, and it must find records to replay
+        proc, replayed = spawn_victim(drain_delay=0.0)
+        assert replayed > 0, (
+            "restart replayed nothing — the kill never caught a "
+            "deferred backlog"
+        )
+        assert wait_for(
+            lambda: client.monc.osdmap.is_up(victim), 20.0
+        ), "restarted victim never rejoined"
+
+        # verdict half two: the heal CLEARS the check and drains the
+        # degraded count to zero
+        def quiet():
+            rc2, outb, _o = client.mon_command({"prefix": "health"})
+            if rc2 != 0 or "PG_DEGRADED" in json.loads(outb).get(
+                "checks_detail", {}
+            ):
+                return False
+            rc2, outb, _o = client.mon_command({"prefix": "status"})
+            if rc2 != 0:
+                return False
+            data = json.loads(outb).get("pgmap", {}).get("data", {})
+            return int(data.get("degraded", 0)) == 0
+
+        assert wait_for(quiet, 60.0), (
+            "PG_DEGRADED never cleared after the replay + re-peer"
+        )
+
+        # zero acked-write loss, byte-identical to the ack-time oracle
+        lost = 0
+        for oid, data in sorted(acked.items()):
+            got = io.read(oid)
+            assert got == data, f"acked write {oid} diverged"
+            lost += got != data
+        assert lost == 0
+
+        return {
+            "seed": seed,
+            "acked_writes": len(acked),
+            "writes_after_kill": len(acked) - acked_at_kill,
+            "replayed_records": replayed,
+            "degraded_peak": degraded_peak[0],
+            "pg_degraded_raised": True,
+            "pg_degraded_cleared": True,
+            "client_errors": len(errors),
+        }
+    finally:
+        if client is not None:
+            client.shutdown()
+        mgr.shutdown()
+        if proc is not None:
+            proc.kill()
+            proc.wait(10)
+        for o in osds.values():
+            o._stop.set()
+            o._workq.put(None)
+            o.messenger.shutdown()
+        mon_msgr.shutdown()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 SCENARIOS = {
     "mon_netsplit": scenario_mon_netsplit,
     "asymmetric_partition": scenario_asymmetric_partition,
     "lossy_link": scenario_lossy_link,
     "fill_to_full": scenario_fill_to_full,
     "kill_osd_at_fill": scenario_kill_osd_at_fill,
+    "kill_storm_wal": scenario_kill_storm_wal,
 }
 
 

@@ -283,3 +283,12 @@ def test_scenario_kill_osd_at_fill():
     result = chaos.scenario_kill_osd_at_fill()
     assert result["slo"]["held"]
     assert result["recovery_batches"] >= 1
+
+
+@pytest.mark.slow
+def test_scenario_kill_storm_wal():
+    result = chaos.scenario_kill_storm_wal()
+    assert result["replayed_records"] > 0
+    assert result["pg_degraded_raised"]
+    assert result["pg_degraded_cleared"]
+    assert result["degraded_peak"] > 0

@@ -48,7 +48,11 @@ def _wait(fn, timeout=60.0, msg="condition"):
         except Exception:
             pass
         time.sleep(0.2)
-    raise AssertionError(f"timeout waiting for {msg}")
+    # msg may be a callable so the failure line carries state sampled
+    # AT the timeout (e.g. the agent's last swallowed sync error)
+    raise AssertionError(
+        f"timeout waiting for {msg() if callable(msg) else msg}"
+    )
 
 
 def test_zone_sync_bootstrap_and_incremental(zones):
@@ -69,8 +73,15 @@ def test_zone_sync_bootstrap_and_incremental(zones):
         # bootstrap: wait for the COMPLETION signal (full_syncs),
         # not the first copied object — p2/lifecycle/marker land
         # after p1, so keying the wait on p1 raced the tail of the
-        # full sync under load (the long-standing bootstrap flake)
-        _wait(lambda: agent.full_syncs >= 1, msg="bootstrap")
+        # full sync under load (the long-standing bootstrap flake;
+        # re-probed 30/30 green after that fix — if this ever trips
+        # again, the message carries the agent's last sync error)
+        _wait(
+            lambda: agent.full_syncs >= 1,
+            msg=lambda: (
+                f"bootstrap (agent.last_error={agent.last_error!r})"
+            ),
+        )
         assert b.get_object("photos", "p1.jpg", user=SYSTEM) == b"jpeg-one"
         assert b.get_object("photos", "p2.jpg", user=SYSTEM) == b"jpeg-two"
         assert b._bucket_rec("photos")["owner"] == "alice"

@@ -1,0 +1,400 @@
+"""WALStore tests: commit-at-append semantics, deferred
+read-through-the-log, group commit, exact crash replay (the clone
+counterexample), residency-binds-commit-point, and the tier-1 fast
+variant of the SIGKILL gate — kill a writer mid small-write storm,
+remount, and require byte-identity for every acked write."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.store import BlockStore, MemStore, Transaction, WALStore
+from ceph_tpu.store.objectstore import StoreError, residency_gens
+from ceph_tpu.store.wal_store import META_COLL
+
+
+def test_basic_roundtrip_and_passthrough(tmp_path):
+    w = WALStore(MemStore(), tmp_path / "wal")
+    w.queue_transaction(
+        Transaction()
+        .create_collection("c")
+        .write("c", "o", 0, b"hello world")
+        .setattr("c", "o", "k", b"v")
+        .omap_setkeys("c", "o", {"mk": b"mv"})
+    )
+    assert w.flush()
+    # drained: reads hit the inner store, not the overlay
+    before = w.wal_perf.dump()["l_os_wal_reads_from_log"]
+    assert w.read("c", "o") == b"hello world"
+    assert w.getattr("c", "o", "k") == b"v"
+    assert w.omap_get("c", "o") == {"mk": b"mv"}
+    assert w.stat("c", "o") == 11
+    assert w.list_objects("c") == ["o"]
+    assert w.wal_perf.dump()["l_os_wal_reads_from_log"] == before
+    w.close()
+
+
+def test_meta_collection_hidden(tmp_path):
+    inner = MemStore()
+    w = WALStore(inner, tmp_path / "wal")
+    w.queue_transaction(Transaction().create_collection("pg_1"))
+    w.flush()
+    assert w.list_collections() == ["pg_1"]
+    assert not w.coll_exists(META_COLL)
+    # the stamp plumbing really lives in the inner store
+    assert inner.coll_exists(META_COLL)
+    w.close()
+
+
+def test_deferred_read_through_wal(tmp_path):
+    """The BlueStore deferred-read contract: an acked-but-unapplied
+    write must be observable through every read surface."""
+    inner = MemStore()
+    w = WALStore(inner, tmp_path / "wal")
+    w.queue_transaction(Transaction().create_collection("c"))
+    w.flush()
+    w.drain_paused = True
+    w.queue_transaction(
+        Transaction()
+        .write("c", "a", 0, b"deferred bytes")
+        .setattr("c", "a", "x", b"1")
+        .omap_setkeys("c", "a", {"k": b"v"})
+    )
+    w.queue_transaction(Transaction().write("c", "a", 9, b"BYTES"))
+    # acked but NOT applied: the inner store has no object yet
+    assert not inner.exists("c", "a")
+    assert w.exists("c", "a")
+    assert w.read("c", "a") == b"deferred BYTES"
+    assert w.read("c", "a", 9, 5) == b"BYTES"
+    assert w.stat("c", "a") == 14
+    assert w.getattr("c", "a", "x") == b"1"
+    assert w.list_attrs("c", "a") == {"x": b"1"}
+    assert w.omap_get("c", "a") == {"k": b"v"}
+    assert w.omap_get_vals("c", "a") == {"k": b"v"}
+    assert w.list_objects("c") == ["a"]
+    assert w.wal_perf.dump()["l_os_wal_reads_from_log"] > 0
+    assert w.wal_perf.dump()["l_os_wal_pending_records"] == 2
+    # drain: same bytes from the inner store, overlay empty
+    w.drain_paused = False
+    assert w.flush()
+    assert inner.read("c", "a") == b"deferred BYTES"
+    assert w.read("c", "a") == b"deferred BYTES"
+    assert w.wal_perf.dump()["l_os_wal_pending_records"] == 0
+    w.close()
+
+
+def test_deferred_remove_and_clone_overlay(tmp_path):
+    w = WALStore(MemStore(), tmp_path / "wal")
+    w.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", 0, b"v1")
+    )
+    w.flush()
+    w.drain_paused = True
+    w.queue_transaction(Transaction().clone("c", "o", "snap"))
+    w.queue_transaction(Transaction().write("c", "o", 0, b"v2"))
+    w.queue_transaction(Transaction().remove("c", "o"))
+    # overlay: snap froze v1, o was rewritten then removed
+    assert w.read("c", "snap") == b"v1"
+    assert not w.exists("c", "o")
+    assert w.list_objects("c") == ["snap"]
+    with pytest.raises(StoreError):
+        w.read("c", "o")
+    w.drain_paused = False
+    w.flush()
+    assert w.read("c", "snap") == b"v1"
+    assert not w.exists("c", "o")
+    w.close()
+
+
+def test_validation_is_synchronous(tmp_path):
+    """A bad transaction fails at queue_transaction, exactly like a
+    synchronous store — even against overlay-only state."""
+    w = WALStore(MemStore(), tmp_path / "wal")
+    with pytest.raises(StoreError):
+        w.queue_transaction(Transaction().write("nope", "o", 0, b"x"))
+    w.drain_paused = True
+    w.queue_transaction(
+        Transaction().create_collection("c").touch("c", "o")
+    )
+    # validates against the pending overlay: "c" exists only there
+    w.queue_transaction(Transaction().setattr("c", "o", "k", b"v"))
+    with pytest.raises(StoreError):
+        w.queue_transaction(Transaction().setattr("c", "gone", "k", b"v"))
+    with pytest.raises(StoreError):
+        # rmcoll of a non-empty collection, emptiness decided through
+        # the overlay
+        w.queue_transaction(Transaction().remove_collection("c"))
+    w.queue_transaction(
+        Transaction().remove("c", "o").remove_collection("c")
+    )
+    assert not w.coll_exists("c")
+    w.drain_paused = False
+    w.flush()
+    assert w.wal_perf.dump()["l_os_wal_apply_errors"] == 0
+    w.close()
+
+
+def test_large_write_applies_through(tmp_path):
+    """Transactions at/over wal_prefer_deferred_size ack only after
+    the in-order apply (the non-deferred BlueStore txc)."""
+    inner = MemStore()
+    w = WALStore(inner, tmp_path / "wal", prefer_deferred_size=4096)
+    big = b"B" * 8192
+    w.queue_transaction(
+        Transaction().create_collection("c").write("c", "big", 0, big)
+    )
+    # acked == applied: no flush needed
+    assert inner.read("c", "big") == big
+    dump = w.wal_perf.dump()
+    assert dump["l_os_wal_appends"] == 1
+    assert dump["l_os_wal_deferred"] == 0
+    w.queue_transaction(Transaction().write("c", "small", 0, b"s"))
+    assert w.wal_perf.dump()["l_os_wal_deferred"] == 1
+    w.close()
+
+
+def test_group_commit_accounting(tmp_path):
+    """Concurrent small writers share barriers; the counter algebra
+    (group_records == appends, barrier_waits == appends - barriers)
+    holds regardless of how the groups landed."""
+    w = WALStore(
+        BlockStore(tmp_path / "bs", sync=False),
+        tmp_path / "wal",
+        max_group_txc=8,
+        flush_interval_ms=2.0,
+    )
+    w.queue_transaction(Transaction().create_collection("c"))
+    n_threads, n_each = 8, 20
+    errs: list = []
+
+    def writer(t):
+        try:
+            for i in range(n_each):
+                w.queue_transaction(
+                    Transaction().write(
+                        "c", f"o{t}_{i}", 0, bytes([t]) * 512
+                    )
+                )
+        except StoreError as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=writer, args=(t,))
+        for t in range(n_threads)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs
+    assert w.flush()
+    dump = w.wal_perf.dump()
+    appends = n_threads * n_each + 1
+    assert dump["l_os_wal_appends"] == appends
+    assert dump["l_os_wal_group_records"]["sum"] == appends
+    assert dump["l_os_wal_group_records"]["avgcount"] == (
+        dump["l_os_wal_barriers"]
+    )
+    assert dump["l_os_wal_barrier_waits"] == (
+        appends - dump["l_os_wal_barriers"]
+    )
+    assert dump["l_os_wal_applies"] == appends
+    for t in range(n_threads):
+        for i in range(n_each):
+            assert w.read("c", f"o{t}_{i}") == bytes([t]) * 512
+    w.close()
+
+
+def test_replay_exact_not_just_convergent(tmp_path):
+    """The clone counterexample that kills checkpoint-offset replay:
+    txn2 clones o->p, txn3 rewrites o.  Re-applying txn2 after txn3
+    already landed would clone the NEW o into p.  The seq stamp makes
+    replay start exactly after the last applied record."""
+    inner = BlockStore(tmp_path / "bs", sync=False)
+    w = WALStore(inner, tmp_path / "wal")
+    w.drain_paused = True
+    w.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", 0, b"OLD")
+    )
+    w.queue_transaction(Transaction().clone("c", "o", "p"))
+    w.queue_transaction(Transaction().write("c", "o", 0, b"NEW"))
+    # manually drain ONLY the first two records (create+write, clone),
+    # leaving the rewrite committed-but-unapplied — the partial-apply
+    # state a crash mid-drain leaves behind
+    with w._drain_cv:
+        for _ in range(2):
+            w._apply_one(w._pending[min(w._pending)])
+    assert inner.read("c", "p") == b"OLD"
+    # simulate SIGKILL: abandon without close/flush
+    w._closed = True
+
+    w2 = WALStore(BlockStore(tmp_path / "bs", sync=False), tmp_path / "wal")
+    # exactly ONE record replayed (the rewrite); the clone was NOT
+    # re-applied over the new o
+    assert w2.replayed_records == 1
+    assert w2.read("c", "o") == b"NEW"
+    assert w2.read("c", "p") == b"OLD"
+    assert w2.wal_perf.dump()["l_os_wal_apply_errors"] == 0
+    w2.close()
+
+
+def test_replay_into_empty_memstore_inner(tmp_path):
+    """A MemStore inner loses everything at crash; the WAL (never
+    truncated for non-durable inners) rebuilds the full state."""
+    w = WALStore(MemStore(), tmp_path / "wal")
+    w.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", 0, b"abc")
+    )
+    w.queue_transaction(Transaction().omap_setkeys("c", "o", {"k": b"v"}))
+    w.flush()
+    w._closed = True  # crash: no close, inner state gone with the process
+
+    w2 = WALStore(MemStore(), tmp_path / "wal")
+    assert w2.replayed_records == 2
+    assert w2.read("c", "o") == b"abc"
+    assert w2.omap_get("c", "o") == {"k": b"v"}
+    w2.close()
+
+
+def test_checkpoint_truncates_wal(tmp_path):
+    inner = BlockStore(tmp_path / "bs", sync=False)
+    w = WALStore(inner, tmp_path / "wal", checkpoint_bytes=2048)
+    w.queue_transaction(Transaction().create_collection("c"))
+    for i in range(16):
+        w.queue_transaction(
+            Transaction().write("c", f"o{i}", 0, bytes([i]) * 512)
+        )
+    w.compact()
+    assert os.path.getsize(tmp_path / "wal" / "wal.log") == 0
+    assert (tmp_path / "wal" / "wal.ckpt").exists()
+    assert w.wal_perf.dump()["l_os_wal_checkpoints"] >= 1
+    w.close()
+
+    w2 = WALStore(BlockStore(tmp_path / "bs", sync=False), tmp_path / "wal")
+    # everything was checkpointed: nothing to replay, state intact
+    assert w2.replayed_records == 0
+    for i in range(16):
+        assert w2.read("c", f"o{i}") == bytes([i]) * 512
+    w2.close()
+
+
+def test_residency_binds_commit_point(tmp_path):
+    """The txn-gen seam: the generation a writer registers a resident
+    payload under is the one its WAL COMMIT assigned — the deferred
+    apply must not move it (the drain bumps only the inner store's
+    token), and a later txn must still invalidate it."""
+    from ceph_tpu.ops.residency import ResidencyCache
+
+    w = WALStore(MemStore(), tmp_path / "wal")
+    w.queue_transaction(Transaction().create_collection("c"))
+    w.flush()
+    w.drain_paused = True
+    cache = ResidencyCache(capacity_bytes=1 << 20)
+    payload = b"R" * 4096
+    w.queue_transaction(Transaction().write("c", "o", 0, payload))
+    # the product write path: register right after the commit acks
+    buf = cache.put_committed(w, "c", "o", data=payload)
+    assert buf is not None
+    # deferred window: the registration is live (commit bound the gen)
+    assert cache.get(w, "c", "o") is not None
+    # the drain's apply must NOT invalidate it
+    w.drain_paused = False
+    assert w.flush()
+    assert cache.get(w, "c", "o") is not None
+    # a second commit names the object: registration goes stale at
+    # the COMMIT, before the apply
+    w.drain_paused = True
+    w.queue_transaction(Transaction().write("c", "o", 0, b"x"))
+    assert cache.get(w, "c", "o") is None
+    w.drain_paused = False
+    w.flush()
+    w.close()
+
+
+_STORM_WRITER = """
+import sys
+from ceph_tpu.store import BlockStore, Transaction, WALStore
+w = WALStore(
+    BlockStore(sys.argv[1], sync=False), sys.argv[2],
+    drain_delay=0.2,  # keep records committed-but-unapplied at kill
+)
+w.queue_transaction(Transaction().create_collection("c"))
+print("ready", flush=True)
+i = 0
+while True:  # 4k small-write storm until killed
+    oid = f"o{i}"
+    w.queue_transaction(
+        Transaction().write("c", oid, 0, (i % 256).to_bytes(1, "little") * 4096)
+    )
+    print(oid, flush=True)  # the acked oracle: printed AFTER the ack
+    i += 1
+"""
+
+
+def test_sigkill_storm_replays_every_acked_write(tmp_path):
+    """Tier-1 fast variant of the chaos kill-storm gate: SIGKILL a
+    process mid small-write storm; the remount must replay the WAL
+    and serve every acked write byte-identical (zero acked loss)."""
+    bs, wal = str(tmp_path / "bs"), str(tmp_path / "wal")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _STORM_WRITER, bs, wal],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        acked = []
+        while len(acked) < 40:
+            acked.append(proc.stdout.readline().strip())
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(10)
+    assert all(a.startswith("o") for a in acked), acked
+
+    w = WALStore(BlockStore(bs, sync=False), wal)
+    # the slow drain guarantees a committed-but-unapplied backlog at
+    # kill time, so the remount really exercised replay
+    assert w.replayed_records > 0
+    assert w.wal_perf.dump()["l_os_wal_replay_records"] == (
+        w.replayed_records
+    )
+    for oid in acked:
+        i = int(oid[1:])
+        assert w.read("c", oid) == (i % 256).to_bytes(1, "little") * 4096
+    w.close()
+
+
+def test_wal_under_osd_commit_and_perf(tmp_path):
+    """OSD wiring: wal_dir wraps the store, commits flow end-to-end,
+    and the l_os_wal_* family rides the OSD perf dump."""
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_osd_daemon import MiniCluster
+
+    from ceph_tpu.msg.message import OSD_OP_READ, OSD_OP_WRITEFULL
+
+    c = MiniCluster()
+    try:
+        for i in range(3):
+            c.start_osd(i, wal_dir=str(tmp_path / f"osd{i}-wal"))
+        c.wait_active()
+        reply = c.op("1.0", "wal_obj", OSD_OP_WRITEFULL, b"w" * 4096)
+        assert reply.ok
+        reply = c.op("1.0", "wal_obj", OSD_OP_READ)
+        assert reply.ok and reply.data == b"w" * 4096
+        # the l_os_wal_* family must ride the OSD perf dump (same
+        # merge the MMgrReport builder uses)
+        appends = 0
+        for osd in c.osds.values():
+            wal_perf = getattr(osd.store, "wal_perf", None)
+            assert wal_perf is not None
+            appends += wal_perf.dump()["l_os_wal_appends"]
+        assert appends >= 1
+    finally:
+        c.shutdown()

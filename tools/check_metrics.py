@@ -142,6 +142,25 @@ RGW_INDEX_COUNTERS = (
     "l_rgw_reshard_passes",
     "l_rgw_reshard_in_progress",
 )
+# WAL-plane counters the wal_store schema must declare
+# (store/wal_store.py build_wal_perf — the bench wal section, the
+# chaos kill-storm verdict, and the mgr exporter read exactly these)
+WAL_COUNTERS = (
+    "l_os_wal_appends",
+    "l_os_wal_append_bytes",
+    "l_os_wal_deferred",
+    "l_os_wal_deferred_bytes",
+    "l_os_wal_barriers",
+    "l_os_wal_group_records",
+    "l_os_wal_barrier_waits",
+    "l_os_wal_reads_from_log",
+    "l_os_wal_applies",
+    "l_os_wal_apply_errors",
+    "l_os_wal_replay_records",
+    "l_os_wal_checkpoints",
+    "l_os_wal_pending_records",
+    "l_os_wal_pending_bytes",
+)
 # recovery-storm counters the OSD schema must declare (the
 # l_osd_recovery_* block: batched decode rebuild progress + the
 # survivor-read fan-in the LRC locality claim is measured from)
@@ -422,6 +441,20 @@ def check_recovery_counters() -> list[str]:
     return [
         f"osd schema: recovery counter {name!r} missing"
         for name in RECOVERY_COUNTERS
+        if name not in declared
+    ]
+
+
+def check_wal_counters() -> list[str]:
+    """The WAL plane: build_wal_perf must keep declaring the
+    l_os_wal_* family the bench wal section and the kill-storm chaos
+    verdict read."""
+    from ceph_tpu.store.wal_store import build_wal_perf
+
+    declared = set(build_wal_perf()._counters)
+    return [
+        f"wal schema: counter {name!r} missing"
+        for name in WAL_COUNTERS
         if name not in declared
     ]
 
@@ -860,6 +893,7 @@ def product_counter_sets():
     from ceph_tpu.osd.daemon import build_osd_perf
     from ceph_tpu.osd.mapping import _build_perf as build_mapping_perf
     from ceph_tpu.rgw.index import build_rgw_perf
+    from ceph_tpu.store.wal_store import build_wal_perf
 
     from ceph_tpu.ops.residency import ensure_counters
 
@@ -881,6 +915,7 @@ def product_counter_sets():
         build_msgr_perf("osd.0"),
         build_stack_perf(default_workers()),
         build_rgw_perf("rgw"),
+        build_wal_perf(),
     ]
 
 
@@ -912,6 +947,7 @@ def check_all(sets=None) -> list[str]:
         errors.extend(check_dispatch_counters())
         errors.extend(check_recovery_counters())
         errors.extend(check_rgw_counters())
+        errors.extend(check_wal_counters())
         errors.extend(product_histogram_exposition())
         errors.extend(product_pgmap_exposition())
     return errors
