@@ -46,6 +46,7 @@ kernels (ops/scrub_kernels.py) before re-applying.
 
 from __future__ import annotations
 
+import logging
 import os
 import pathlib
 import threading
@@ -70,6 +71,8 @@ from .objectstore import (
     encode_transaction,
     residency_gens,
 )
+
+log = logging.getLogger(__name__)
 
 _WAL = "wal.log"
 _CKPT = "wal.ckpt"
@@ -227,7 +230,8 @@ class WALStore(ObjectStore):
         # validate+enqueue under it, readers materialize under it, the
         # drain applies+unpends under it (so a reader can never see a
         # record both in the overlay and in the inner store).  Lock
-        # order: _state_lock -> inner's own lock, always.
+        # order: _state_lock -> _wal_cv and
+        # _state_lock -> inner's own lock, always.
         self._state_lock = threading.Lock()
         self._drain_cv = threading.Condition(self._state_lock)
         self._pending: dict[int, _Pending] = {}
@@ -298,9 +302,20 @@ class WALStore(ObjectStore):
                 self.wal_perf.inc(
                     "l_os_wal_pending_bytes", len(payload)
                 )
-            with self._wal_cv:
-                self._wal_q.append(rec)
-                self._wal_cv.notify_all()
+                # seq assignment and WAL enqueue are ONE critical
+                # section (lock order: _state_lock -> _wal_cv): two
+                # committers must hit _wal_q in seq order, or the
+                # writer appends/fsyncs out of order and a crash can
+                # leave a later-seq txn durable without the earlier
+                # txn it was validated against (replay also sorts by
+                # seq defensively, but the prefix it replays must be
+                # seq-contiguous for history to be exact)
+                with self._wal_cv:
+                    if self._closed:
+                        self._unpend(rec)
+                        raise StoreError("wal store is closed")
+                    self._wal_q.append(rec)
+                    self._wal_cv.notify_all()
             rec.synced_ev.wait()
             if rec.error is None and not deferred:
                 rec.applied_ev.wait()
@@ -326,6 +341,12 @@ class WALStore(ObjectStore):
         rmcolls = set()
         for op in txn.ops:
             kind, cid = op[0], op[1]
+            if cid == META_COLL:
+                # the applied-seq stamp is store plumbing; a user txn
+                # overwriting it would corrupt the exact-replay point
+                raise StoreError(
+                    f"collection {META_COLL} is reserved (-EPERM)"
+                )
             oids = by_cid.setdefault(cid, set())
             if kind == "clone":
                 oids.update((op[2], op[3]))
@@ -467,11 +488,26 @@ class WALStore(ObjectStore):
         try:
             self.inner.queue_transaction(inner_txn)
             self.wal_perf.inc("l_os_wal_applies")
-        except StoreError:
+        except StoreError as err:
             # validated at commit; an inner rejection here means the
             # inner state diverged out-of-band — count it, keep the
-            # drain alive (the KStore mount-replay precedent)
+            # drain alive (the KStore mount-replay precedent).  A
+            # non-deferred caller is still blocked on applied_ev and
+            # must RAISE, not return success for bytes that never
+            # landed; a deferred caller is long gone, so the best we
+            # can do for its acked state is shout (the record is
+            # still in the WAL and the applied stamp did not
+            # advance, so a remount retries the apply)
             self.wal_perf.inc("l_os_wal_apply_errors")
+            if rec.deferred:
+                log.error(
+                    "wal drain: apply of acked deferred txn seq=%d "
+                    "failed, acked state diverged until remount "
+                    "replay: %s",
+                    rec.seq, err,
+                )
+            else:
+                rec.error = f"wal apply failed: {err}"
         self._unpend(rec)
         rec.applied_ev.set()
 
@@ -549,15 +585,41 @@ class WALStore(ObjectStore):
                         records = records[:i]
                         pos = ends[i - 1] if i else 0
                         break
-            if pos < len(raw):
-                truncate_tail(wal, pos)
-            for rec in records:
-                last_seq = max(last_seq, rec.seq)
+            # decode-verify in log order: a crc-valid record whose
+            # txn fails to decode is as fatal as a torn one — every
+            # later record was validated against its effects, so
+            # applying them without it would fork the replayed
+            # history.  Stop there and truncate, loudly.
+            decoded: list[tuple[WALRecord, Transaction | None]] = []
+            for i, rec in enumerate(records):
                 if rec.seq <= applied:
+                    # already stamped into the inner store
+                    decoded.append((rec, None))
                     continue
                 try:
                     txn = decode_transaction(Decoder(rec.payload))
-                except DecodeError:
+                except DecodeError as err:
+                    self.wal_perf.inc("l_os_wal_apply_errors")
+                    log.error(
+                        "wal replay: record seq=%d is crc-valid but "
+                        "undecodable (%s); discarding it and %d "
+                        "later record(s)",
+                        rec.seq, err, len(records) - i - 1,
+                    )
+                    records = records[:i]
+                    pos = ends[i - 1] if i else 0
+                    break
+                decoded.append((rec, txn))
+            if pos < len(raw):
+                truncate_tail(wal, pos)
+            # defensive: apply in seq order even if a log written by
+            # an earlier build interleaved records (the commit path
+            # holds seq assignment and enqueue in one critical
+            # section, so a healthy log is already ordered)
+            decoded.sort(key=lambda p: p[0].seq)
+            for rec, txn in decoded:
+                last_seq = max(last_seq, rec.seq)
+                if txn is None:
                     continue
                 txn.setattr(
                     META_COLL, META_OID, META_ATTR,
@@ -598,13 +660,24 @@ class WALStore(ObjectStore):
         if self._closed:
             return
         self.flush()
-        self._closed = True
+        # set under _wal_cv so a committer's enqueue (which re-checks
+        # _closed under the same lock) can never slip a record into
+        # _wal_q after the writer thread decided to exit
         with self._wal_cv:
+            self._closed = True
             self._wal_cv.notify_all()
         with self._drain_cv:
             self._drain_cv.notify_all()
         self._writer_thread.join(timeout=5.0)
         self._drain_thread.join(timeout=5.0)
+        # the writer drains _wal_q before exiting; if it wedged past
+        # the join timeout, fail the leftovers so no committer blocks
+        # forever on synced_ev
+        with self._wal_cv:
+            leftovers = self._wal_q[:]
+            self._wal_q.clear()
+        for rec in leftovers:
+            self._fail_record(rec, "wal store closed before append")
         if not self._wal.closed:
             self._wal.flush()
             if self.sync:
@@ -696,6 +769,13 @@ class WALStore(ObjectStore):
         """Run ``fn(store)`` against the effective state: the inner
         store directly when the cid has no pending records, else a
         materialized scratch."""
+        if cid == META_COLL:
+            # the stamp plumbing is store-internal: the whole read
+            # surface presents it as absent, matching
+            # list_collections/coll_exists (an empty MemStore gives
+            # the exact missing-collection semantics per surface —
+            # exists() -> False, read() -> -ENOENT, ...)
+            return fn(MemStore())
         with self._state_lock:
             if not self._by_cid.get(cid):
                 return fn(self.inner)
@@ -744,6 +824,8 @@ class WALStore(ObjectStore):
         )
 
     def list_objects(self, cid) -> list[str]:
+        if cid == META_COLL:
+            raise StoreError(f"no collection {cid} (-ENOENT)")
         with self._state_lock:
             seqs = self._by_cid.get(cid)
             if not seqs:

@@ -15,9 +15,19 @@ import time
 
 import pytest
 
+from ceph_tpu.common.encoding import Encoder
 from ceph_tpu.store import BlockStore, MemStore, Transaction, WALStore
-from ceph_tpu.store.objectstore import StoreError, residency_gens
-from ceph_tpu.store.wal_store import META_COLL
+from ceph_tpu.store.framed_log import append_frame
+from ceph_tpu.store.objectstore import (
+    StoreError,
+    encode_transaction,
+    residency_gens,
+)
+from ceph_tpu.store.wal_store import (
+    META_COLL,
+    encode_wal_record,
+    make_wal_record,
+)
 
 
 def test_basic_roundtrip_and_passthrough(tmp_path):
@@ -50,6 +60,35 @@ def test_meta_collection_hidden(tmp_path):
     assert not w.coll_exists(META_COLL)
     # the stamp plumbing really lives in the inner store
     assert inner.coll_exists(META_COLL)
+    w.close()
+
+
+def test_meta_collection_rejected_and_absent(tmp_path):
+    """The applied-seq stamp is store plumbing: a user transaction
+    naming it must fail validation (it could overwrite the replay
+    point), and every read surface must present it as nonexistent."""
+    w = WALStore(MemStore(), tmp_path / "wal")
+    w.queue_transaction(Transaction().create_collection("c"))
+    with pytest.raises(StoreError):
+        w.queue_transaction(
+            Transaction().setattr(META_COLL, "applied", "seq", b"\0" * 8)
+        )
+    with pytest.raises(StoreError):
+        w.queue_transaction(
+            Transaction().write(META_COLL, "applied", 0, b"x")
+        )
+    with pytest.raises(StoreError):
+        w.queue_transaction(Transaction().remove_collection(META_COLL))
+    assert not w.exists(META_COLL, "applied")
+    with pytest.raises(StoreError):
+        w.read(META_COLL, "applied")
+    with pytest.raises(StoreError):
+        w.getattr(META_COLL, "applied", "seq")
+    with pytest.raises(StoreError):
+        w.list_objects(META_COLL)
+    # the rejections left no pending state behind
+    assert w.flush()
+    assert w.wal_perf.dump()["l_os_wal_pending_records"] == 0
     w.close()
 
 
@@ -316,6 +355,137 @@ def test_residency_binds_commit_point(tmp_path):
     w.drain_paused = False
     w.flush()
     w.close()
+
+
+def _append_wal_record(f, seq, txn_or_payload):
+    """Hand-frame one wal_record (the mount-path tests forge logs a
+    healthy commit path would never write)."""
+    if isinstance(txn_or_payload, Transaction):
+        e = Encoder()
+        encode_transaction(e, txn_or_payload)
+        payload = e.getvalue()
+    else:
+        payload = txn_or_payload
+    re = Encoder()
+    encode_wal_record(re, make_wal_record(seq, payload))
+    append_frame(f, re.getvalue(), sync=False)
+
+
+def test_mount_replays_in_seq_order(tmp_path):
+    """Defensive replay ordering: a log whose records are physically
+    out of seq order (written by a build without the atomic
+    seq-assign/enqueue section) must still apply in seq order, or
+    overlapping writes land backwards."""
+    waldir = tmp_path / "wal"
+    waldir.mkdir(parents=True)
+    with open(waldir / "wal.log", "ab") as f:
+        _append_wal_record(
+            f, 2, Transaction().write("c", "o", 0, b"TWO")
+        )
+        _append_wal_record(
+            f,
+            1,
+            Transaction().create_collection("c").write("c", "o", 0, b"ONE"),
+        )
+    w = WALStore(MemStore(), waldir)
+    assert w.replayed_records == 2
+    # log-order apply would fail seq 2 (no collection yet) and leave
+    # o == b"ONE"
+    assert w.wal_perf.dump()["l_os_wal_apply_errors"] == 0
+    assert w.read("c", "o") == b"TWO"
+    w.close()
+
+
+def test_mount_stops_at_undecodable_record(tmp_path):
+    """A crc-valid record whose transaction fails to decode is as
+    fatal as a torn one: later records were validated against its
+    effects, so replay stops there, counts it, and truncates."""
+    waldir = tmp_path / "wal"
+    waldir.mkdir(parents=True)
+    with open(waldir / "wal.log", "ab") as f:
+        _append_wal_record(
+            f, 1, Transaction().create_collection("c").write("c", "a", 0, b"A")
+        )
+        _append_wal_record(f, 2, b"\xff\xff\xff\xff")  # crc-valid garbage
+        _append_wal_record(f, 3, Transaction().write("c", "b", 0, b"B"))
+    w = WALStore(MemStore(), waldir)
+    assert w.replayed_records == 1
+    assert w.wal_perf.dump()["l_os_wal_apply_errors"] == 1
+    assert w.read("c", "a") == b"A"
+    assert not w.exists("c", "b")
+    # the undecodable record and everything after it were truncated,
+    # so a second mount replays the same clean prefix
+    w.close()
+    w2 = WALStore(MemStore(), waldir)
+    assert w2.replayed_records == 1
+    assert w2.wal_perf.dump()["l_os_wal_apply_errors"] == 0
+    assert w2.read("c", "a") == b"A"
+    w2.close()
+
+
+def test_nondeferred_apply_failure_raises(tmp_path):
+    """A large (non-deferred) writer blocks until the apply: if the
+    inner store rejects the txn (out-of-band divergence), the caller
+    must get a StoreError, not a success ack for vanished bytes."""
+    inner = MemStore()
+    w = WALStore(inner, tmp_path / "wal", prefer_deferred_size=16)
+    w.queue_transaction(Transaction().create_collection("c"))
+    w.flush()
+    real = inner.queue_transaction
+
+    def boom(txn):
+        raise StoreError("injected divergence")
+
+    inner.queue_transaction = boom
+    try:
+        with pytest.raises(StoreError, match="wal apply failed"):
+            w.queue_transaction(
+                Transaction().write("c", "o", 0, b"X" * 64)
+            )
+    finally:
+        inner.queue_transaction = real
+    assert w.wal_perf.dump()["l_os_wal_apply_errors"] == 1
+    assert w.wal_perf.dump()["l_os_wal_pending_records"] == 0
+    w.close()
+
+
+def test_deferred_apply_failure_is_loud(tmp_path, caplog):
+    """A deferred writer is long gone when the drain applies; a
+    failed apply of its acked record must at least be counted and
+    logged, never silently dropped."""
+    import logging
+
+    inner = MemStore()
+    w = WALStore(inner, tmp_path / "wal")
+    w.queue_transaction(Transaction().create_collection("c"))
+    w.flush()
+    w.drain_paused = True
+    w.queue_transaction(Transaction().write("c", "o", 0, b"x"))
+    real = inner.queue_transaction
+
+    def boom(txn):
+        raise StoreError("injected divergence")
+
+    inner.queue_transaction = boom
+    try:
+        with caplog.at_level(
+            logging.ERROR, logger="ceph_tpu.store.wal_store"
+        ):
+            w.drain_paused = False
+            assert w.flush()
+    finally:
+        inner.queue_transaction = real
+    assert w.wal_perf.dump()["l_os_wal_apply_errors"] == 1
+    assert "acked deferred" in caplog.text
+    w.close()
+
+
+def test_queue_after_close_fails_fast(tmp_path):
+    w = WALStore(MemStore(), tmp_path / "wal")
+    w.queue_transaction(Transaction().create_collection("c"))
+    w.close()
+    with pytest.raises(StoreError, match="closed"):
+        w.queue_transaction(Transaction().write("c", "o", 0, b"x"))
 
 
 _STORM_WRITER = """
