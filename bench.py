@@ -1708,6 +1708,214 @@ def measure_wal() -> dict:
         _shutil.rmtree(workdir, ignore_errors=True)
 
 
+# worker child for measure_procs: one self-contained workload copy
+# (or `copies` thread-copies for the in-process GIL baseline) behind
+# a ready/go stdin barrier, so every worker's measurement window
+# overlaps.  Prints "ready", blocks on stdin, measures `duration`
+# seconds, prints "count <ops>".
+_PROC_WORKER = r"""
+import sys, threading, time
+
+mode, copies, duration = sys.argv[1], int(sys.argv[2]), float(sys.argv[3])
+counts = [0] * copies
+
+if mode == "msgr":
+    from ceph_tpu.msg import Messenger, MPing
+    from ceph_tpu.msg.messenger import Dispatcher
+
+    class Echo(Dispatcher):
+        def ms_dispatch(self, conn, msg):
+            if isinstance(msg, MPing) and not msg.is_reply:
+                conn.send(MPing(tid=msg.tid, from_osd=0,
+                                stamp=msg.stamp, is_reply=True))
+                return True
+            return False
+
+    srv = Messenger("w-srv")
+    srv.add_dispatcher(Echo())
+    srv.bind()
+    cli = Messenger("w-cli")
+    conns = [cli.connect(*srv.bound_addr) for _ in range(copies)]
+
+    def run(i):
+        end = time.perf_counter() + duration
+        n = 0
+        while time.perf_counter() < end:
+            conns[i].call(MPing(stamp=1.0), timeout=10.0)
+            n += 1
+        counts[i] = n
+elif mode == "index":
+    from test_osd_daemon import MiniCluster
+    from ceph_tpu.rados import Rados
+    from ceph_tpu.rgw import RGW
+
+    c = MiniCluster()
+    for i in range(3):
+        c.start_osd(i)
+    c.wait_active()
+    r = Rados("w-idx").connect(*c.mon_addr)
+    r.pool_create("pb", pg_num=8, size=2)
+    gw = RGW(r.open_ioctx("pb"), max_objs_per_shard=0)
+    recs = []
+    for i in range(copies):
+        gw.create_bucket(f"b{i}", shards=8)
+        recs.append(gw._bucket_rec(f"b{i}"))
+    ent = {"size": 64, "etag": "0" * 32, "mtime": 0.0, "owner": None,
+           "acl": {"owner": None, "grants": []}}
+
+    def run(i):
+        end = time.perf_counter() + duration
+        n = 0
+        while time.perf_counter() < end:
+            gw.index.set_entry(f"b{i}", f"o{n % 500:05d}", ent,
+                               rec=recs[i])
+            n += 1
+        counts[i] = n
+else:
+    raise SystemExit(f"unknown mode {mode!r}")
+
+print("ready", flush=True)
+sys.stdin.readline()
+threads = [threading.Thread(target=run, args=(i,)) for i in range(copies)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+print("count", sum(counts), flush=True)
+# skip interpreter teardown: a loaded 1-core box can take >30s to
+# join a mini-cluster's threads, and the parent only needs the count
+import os
+os._exit(0)
+"""
+
+
+def measure_procs() -> dict:
+    """Multi-process scaling plane (ISSUE 19): aggregate messenger
+    messages/s and sharded-index ops/s at 1/2/4/8 worker PROCESSES,
+    against an in-process baseline running the same four workload
+    copies as THREADS — the honest GIL comparison the in-process
+    curves (measure_msgr, measure_rgw_index) cannot make.  Entirely
+    CPU-side; every child pins JAX_PLATFORMS=cpu."""
+    import os as _os
+    import pathlib
+    import subprocess as _subprocess
+    import sys as _sys
+
+    try:
+        cores = len(_os.sched_getaffinity(0))
+    except AttributeError:
+        cores = _os.cpu_count() or 1
+    root = pathlib.Path(__file__).parent
+    env = dict(_os.environ)
+    env["PYTHONPATH"] = _os.pathsep.join(
+        [str(root), str(root / "tests"),
+         env.get("PYTHONPATH", "")]
+    ).rstrip(_os.pathsep)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def rung(mode: str, n_procs: int, copies: int = 1,
+             duration: float = 1.5) -> float:
+        """Aggregate ops/s across n_procs workers whose measurement
+        windows overlap (ready/go barrier)."""
+        procs = [
+            _subprocess.Popen(
+                [_sys.executable, "-c", _PROC_WORKER, mode,
+                 str(copies), str(duration)],
+                stdin=_subprocess.PIPE, stdout=_subprocess.PIPE,
+                env=env, text=True,
+            )
+            for _ in range(n_procs)
+        ]
+        try:
+            for p in procs:
+                line = p.stdout.readline().strip()
+                if line != "ready":
+                    raise RuntimeError(
+                        f"procs worker died during boot: {line!r}"
+                    )
+            for p in procs:
+                p.stdin.write("go\n")
+                p.stdin.flush()
+            total = 0
+            for p in procs:
+                parts = p.stdout.readline().split()
+                if parts[:1] != ["count"]:
+                    raise RuntimeError(
+                        f"procs worker died mid-run: {parts!r}"
+                    )
+                total += int(parts[1])
+            for p in procs:
+                p.wait(timeout=30)
+            return total / duration
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
+
+    rungs = (1, 2, 4, 8)
+    msgr_curve = []
+    index_curve = []
+    for n in rungs:
+        msgr_curve.append(
+            {"procs": n, "msgs_per_s": round(rung("msgr", n), 1)}
+        )
+        index_curve.append(
+            {"procs": n, "ops_per_s": round(rung("index", n), 1)}
+        )
+    # in-process baseline: the SAME four workload copies as threads
+    # in one interpreter — what 4 processes must beat to prove the
+    # scaling is real and not workload slack
+    msgr_inproc = rung("msgr", 1, copies=4)
+    index_inproc = rung("index", 1, copies=4)
+    msgr_4 = msgr_curve[2]["msgs_per_s"]
+    index_4 = index_curve[2]["ops_per_s"]
+    msgr_speedup = round(msgr_4 / max(msgr_inproc, 1e-9), 2)
+    index_speedup = round(index_4 / max(index_inproc, 1e-9), 2)
+    for row in msgr_curve:
+        _log(
+            f"procs msgr @{row['procs']} processes: "
+            f"{row['msgs_per_s']:.0f} msg/s aggregate"
+        )
+    for row in index_curve:
+        _log(
+            f"procs index @{row['procs']} processes: "
+            f"{row['ops_per_s']:.0f} ops/s aggregate"
+        )
+    _log(
+        f"procs speedup @4 processes vs 4 threads in-process: msgr "
+        f"{msgr_speedup}x ({msgr_inproc:.0f} → {msgr_4:.0f}), index "
+        f"{index_speedup}x ({index_inproc:.0f} → {index_4:.0f}) "
+        f"on {cores} core(s)"
+    )
+    if cores < 4:
+        # the honest caveat the artifact must carry: with fewer
+        # cores than workers, multi-process CANNOT beat the GIL
+        # baseline — the curve measures scheduler overhead, not the
+        # runtime.  On a >=4-core host the same section shows the
+        # real scaling.
+        _log(
+            f"procs: only {cores} core(s) visible — speedup is "
+            "core-limited, not a runtime verdict"
+        )
+    return {
+        "procs": {
+            "cores": cores,
+            "msgr": msgr_curve,
+            "index": index_curve,
+            "msgr_inproc_4t_msgs_per_s": round(msgr_inproc, 1),
+            "index_inproc_4t_ops_per_s": round(index_inproc, 1),
+        },
+        # flat regression surfaces (the BENCH_r* trajectory keys):
+        # the 4-process rung is the acceptance point
+        "procs_cores": cores,
+        "procs_msgr_msgs_per_s": msgr_4,
+        "procs_index_ops_per_s": index_4,
+        "procs_msgr_speedup": msgr_speedup,
+        "procs_index_speedup": index_speedup,
+    }
+
+
 def measure_recovery(on_tpu: bool) -> dict:
     """Recovery-storm plane (ROADMAP open item 2): decode-from-
     survivors rebuild throughput before/after the coalesced batched
@@ -2243,6 +2451,15 @@ def main(argv=None) -> None:
 
             traceback.print_exc()
             out["wal_error"] = f"{type(e).__name__}: {e}"
+        # multi-process scaling curves (ISSUE 19): the first numbers
+        # that can exceed one core — CPU-side, section-isolated
+        try:
+            out.update(measure_procs())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            traceback.print_exc()
+            out["procs_error"] = f"{type(e).__name__}: {e}"
         if be != "none":
             # families BEFORE the big crush compiles: the remote
             # compile service degrades late in a long session, and

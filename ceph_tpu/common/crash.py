@@ -111,6 +111,59 @@ def build_report(
     }
 
 
+def build_process_report(
+    entity: str,
+    returncode: int,
+    log_tail: list[str] | None = None,
+    extra_meta: dict | None = None,
+) -> dict:
+    """A crash report for a REAL process death (the supervisor's
+    ceph-crash role): same schema as :func:`build_report`, but the
+    "exception" is the wait status (signal name for a killed child,
+    exit code otherwise) and the backtrace is the tail of the child's
+    captured log — the closest thing to a stack an external observer
+    has."""
+    import signal as _signal
+
+    from ..version import FRAMEWORK_VERSION
+
+    now = time.time()
+    stamp = (
+        datetime.fromtimestamp(now, tz=timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+    )
+    if returncode < 0:
+        try:
+            signame = _signal.Signals(-returncode).name
+        except ValueError:
+            signame = f"signal {-returncode}"
+        exception = f"ProcessDeath: killed by {signame}"
+    else:
+        exception = f"ProcessDeath: exited with status {returncode}"
+    backtrace = [
+        ln[:MAX_BACKTRACE_LINE_LEN] for ln in (log_tail or [])
+    ][-MAX_BACKTRACE_LINES:]
+    meta = {
+        "framework_version": FRAMEWORK_VERSION,
+        "python_version": sys.version.split()[0],
+        "platform": sys.platform,
+        "process_death": True,
+        "returncode": returncode,
+    }
+    if extra_meta:
+        meta.update(extra_meta)
+    return {
+        "crash_id": f"{stamp}_{uuid.uuid4()}",
+        "entity_name": entity,
+        "timestamp": now,
+        "timestamp_iso": stamp,
+        "exception": exception,
+        "backtrace": backtrace,
+        "dout_tail": [],
+        "meta": meta,
+    }
+
+
 def capture(
     entity: str,
     exc: BaseException,

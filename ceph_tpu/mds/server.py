@@ -92,6 +92,7 @@ class MDSDaemon(Dispatcher):
         meta_pool: str,
         beacon_interval: float = 0.5,
         flush_every: int = 16,
+        shared_services: bool | None = None,
     ):
         self.name = name
         self.rados = rados
@@ -148,23 +149,42 @@ class MDSDaemon(Dispatcher):
         # the loop thread deadlock (the op_shardedwq rule every
         # daemon here follows)
         self._workq: queue.Queue = queue.Queue()
-        self._worker = threading.Thread(
-            target=self._work_loop, name=f"mds.{name}.worker",
-            daemon=True,
-        )
-        self._worker.start()
-        self._beacon_thread = threading.Thread(
-            target=self._beacon_loop, name=f"mds.{name}.beacon",
-            daemon=True,
-        )
-        self._beacon_thread.start()
+        self.shared_services = bool(shared_services)
+        self._worker = None
+        self._beacon_thread = None
+        self._beacon_handle = None
+        if self.shared_services:
+            # zero dedicated threads: ops drain through a serial
+            # strand on the shared stack (same FIFO semantics as the
+            # worker thread), beacons ride a stack timer
+            stack = self.msgr._stack
+            self._work_strand = stack.offload.strand()
+            self._beacon_handle = stack.timers.every(
+                self.beacon_interval, self._beacon_once,
+                fire_now=True,
+            )
+        else:
+            self._worker = threading.Thread(
+                target=self._work_loop, name=f"mds.{name}.worker",
+                daemon=True,
+            )
+            self._worker.start()
+            self._beacon_thread = threading.Thread(
+                target=self._beacon_loop, name=f"mds.{name}.beacon",
+                daemon=True,
+            )
+            self._beacon_thread.start()
 
     # -- lifecycle ---------------------------------------------------------
     def shutdown(self) -> None:
         self._stop.set()
         self._workq.put(None)
-        self._beacon_thread.join(timeout=5)
-        self._worker.join(timeout=5)
+        if self._beacon_handle is not None:
+            self._beacon_handle.cancel()
+        if self._beacon_thread is not None:
+            self._beacon_thread.join(timeout=5)
+        if self._worker is not None:
+            self._worker.join(timeout=5)
         if self.state == "active":
             with self._lock:
                 try:
@@ -175,6 +195,11 @@ class MDSDaemon(Dispatcher):
 
     def _beacon_loop(self) -> None:
         while not self._stop.is_set():
+            self._beacon_once()
+            self._stop.wait(self.beacon_interval)
+
+    def _beacon_once(self) -> None:
+        if not self._stop.is_set():
             try:
                 beacon = {
                     "prefix": "mds beacon",
@@ -267,7 +292,6 @@ class MDSDaemon(Dispatcher):
             except Exception:  # noqa: BLE001 — beacons retry forever
                 pass
             self._log_client.flush(self.rados.monc)
-            self._stop.wait(self.beacon_interval)
 
     def _become_active(self, rank: int = 0) -> None:
         """Standby takeover of a RANK: replay that rank's journal
@@ -737,7 +761,12 @@ class MDSDaemon(Dispatcher):
     def ms_dispatch(self, conn: Connection, msg) -> bool:
         if not isinstance(msg, MClientRequest):
             return False
-        self._workq.put((conn, msg))
+        if self.shared_services:
+            self._work_strand.submit(
+                lambda: self._work_one((conn, msg))
+            )
+        else:
+            self._workq.put((conn, msg))
         return True
 
     def _work_loop(self) -> None:
@@ -745,16 +774,21 @@ class MDSDaemon(Dispatcher):
             item = self._workq.get()
             if item is None:
                 return
-            try:
-                self._process(*item)
-            except Exception as e:  # noqa: BLE001 — the worker
-                # survives; the dead op files a crash report
-                import traceback
+            self._work_one(item)
 
-                traceback.print_exc()
-                crash_util.capture(
-                    f"mds.{self.name}", e, clog=self.clog
-                )
+    def _work_one(self, item) -> None:
+        if self._stop.is_set():
+            return
+        try:
+            self._process(*item)
+        except Exception as e:  # noqa: BLE001 — the worker
+            # survives; the dead op files a crash report
+            import traceback
+
+            traceback.print_exc()
+            crash_util.capture(
+                f"mds.{self.name}", e, clog=self.clog
+            )
 
     def _process(self, conn: Connection, msg: MClientRequest) -> None:
         reply = MClientReply(tid=msg.tid)

@@ -129,8 +129,17 @@ class Manager(Dispatcher):
         self,
         modules: list[type[MgrModule]] | None = None,
         name: str = "x",
+        shared_services: bool | None = None,
     ):
         self.name = name
+        # shared-services: the tick loop rides a shared-stack timer
+        # and mgr commands drain through a serial strand instead of a
+        # thread per command — zero dedicated mgr threads (the PR 14
+        # OSD treatment)
+        self.shared_services = bool(shared_services)
+        self._tick_handle = None
+        self._cmd_strand = None
+        self._last_beacon = 0.0
         self.messenger = Messenger("mgr")
         self.monc = MonClient(self.messenger, whoami=-2)
         self.module_options: dict[str, dict] = {}
@@ -194,9 +203,13 @@ class Manager(Dispatcher):
                 except Exception:  # noqa: BLE001 — caller gone
                     pass
 
-            threading.Thread(
-                target=run, name="mgr.command", daemon=True
-            ).start()
+            strand = self._cmd_strand
+            if strand is not None:
+                strand.submit(run)
+            else:
+                threading.Thread(
+                    target=run, name="mgr.command", daemon=True
+                ).start()
             return True
         if isinstance(msg, MPGStats):
             try:
@@ -276,10 +289,17 @@ class Manager(Dispatcher):
         for mtype in self._module_types:
             mod = mtype(self)
             self.modules[mod.NAME] = mod
-        self._ticker = threading.Thread(
-            target=self._tick_loop, name="mgr.tick", daemon=True
-        )
-        self._ticker.start()
+        if self.shared_services:
+            stack = self.messenger._stack
+            self._cmd_strand = stack.offload.strand()
+            self._tick_handle = stack.timers.every(
+                0.2, self._tick_once
+            )
+        else:
+            self._ticker = threading.Thread(
+                target=self._tick_loop, name="mgr.tick", daemon=True
+            )
+            self._ticker.start()
 
     def _beacon(self) -> None:
         try:
@@ -295,6 +315,8 @@ class Manager(Dispatcher):
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self._tick_handle is not None:
+            self._tick_handle.cancel()
         if self._ticker is not None:
             self._ticker.join(timeout=5)
         for mod in self.modules.values():
@@ -305,31 +327,35 @@ class Manager(Dispatcher):
         self.messenger.shutdown()
 
     def _tick_loop(self) -> None:
-        last_beacon = 0.0
         while not self._stop.wait(0.2):
-            now = time.monotonic()
-            if now - last_beacon > 2.0:
-                last_beacon = now
-                self._beacon()
-            for mod in self.modules.values():
-                if now - mod._last_tick < mod.TICK_EVERY:
-                    continue
-                mod._last_tick = now
-                try:
-                    mod.serve()
-                except Exception as e:  # noqa: BLE001 — a module must
-                    # not kill the host (mgr module crash containment);
-                    # the contained crash still files a report
-                    import traceback
+            self._tick_once()
 
-                    traceback.print_exc()
-                    crash_util.capture(
-                        f"mgr.{self.name}",
-                        e,
-                        clog=self.clog,
-                        extra_meta={"module": mod.NAME},
-                    )
-            self._log_client.flush(self.monc)
+    def _tick_once(self) -> None:
+        if self._stop.is_set():
+            return
+        now = time.monotonic()
+        if now - self._last_beacon > 2.0:
+            self._last_beacon = now
+            self._beacon()
+        for mod in self.modules.values():
+            if now - mod._last_tick < mod.TICK_EVERY:
+                continue
+            mod._last_tick = now
+            try:
+                mod.serve()
+            except Exception as e:  # noqa: BLE001 — a module must
+                # not kill the host (mgr module crash containment);
+                # the contained crash still files a report
+                import traceback
+
+                traceback.print_exc()
+                crash_util.capture(
+                    f"mgr.{self.name}",
+                    e,
+                    clog=self.clog,
+                    extra_meta={"module": mod.NAME},
+                )
+        self._log_client.flush(self.monc)
 
     # -- cluster state snapshots (MgrModule.get) ---------------------------
     def get(self, what: str):

@@ -76,6 +76,24 @@ STATE_LEADER = "leader"
 STATE_PEON = "peon"
 
 
+class _StrandQueue:
+    """queue.Queue stand-in for shared-services mode: ``put`` feeds
+    the item straight onto a serial strand of the shared network
+    stack — FIFO, one at a time, on whatever offload thread is free,
+    which is exactly the semantics of one worker thread draining a
+    Queue, minus the thread.  The ``None`` shutdown sentinel is a
+    no-op (strands have no loop to stop)."""
+
+    def __init__(self, strand, handler):
+        self._strand = strand
+        self._handler = handler
+
+    def put(self, item) -> None:
+        if item is None:
+            return
+        self._strand.submit(lambda: self._handler(item))
+
+
 @dataclass
 class MonMap:
     """Monitor cluster membership: rank → address (MonMap role)."""
@@ -109,6 +127,7 @@ class QuorumMonitor(Monitor):
         min_reporters: int = 2,
         election_timeout: float = 1.0,
         lease_interval: float = 0.5,
+        shared_services: bool | None = None,
     ):
         super().__init__(osdmap, store=store, min_reporters=min_reporters)
         self.monmap = monmap
@@ -150,27 +169,49 @@ class QuorumMonitor(Monitor):
         self._ticker: threading.Thread | None = None
         self._stop = threading.Event()
         self.addr: tuple[str, int] | None = None
+        # shared-services: the work/elect queues become strands on
+        # the shared network stack and the tick a stack timer — a
+        # quorum mon then costs ZERO dedicated threads beyond the
+        # paxos fan-out pool (the PR 14 OSD treatment applied to the
+        # mon trio)
+        self.shared_services = bool(shared_services)
+        self._tick_handle = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         """Bind at my monmap address and call the first election."""
         host, port = self.monmap.addrs[self.rank]
         self.addr = self.messenger.bind(host, port)
-        self._worker = threading.Thread(
-            target=self._work_loop, name=f"mon.{self.rank}.wq",
-            daemon=True,
-        )
-        self._worker.start()
-        self._elector = threading.Thread(
-            target=self._elect_loop, name=f"mon.{self.rank}.elect",
-            daemon=True,
-        )
-        self._elector.start()
-        self._ticker = threading.Thread(
-            target=self._tick_loop, name=f"mon.{self.rank}.tick",
-            daemon=True,
-        )
-        self._ticker.start()
+        if self.shared_services:
+            # bind() started the messenger, so the stack is held for
+            # this daemon's whole lifetime — strands/timers on it can
+            # never outlive their carrier
+            stack = self.messenger._stack
+            self._workq = _StrandQueue(
+                stack.offload.strand(), self._work_one
+            )
+            self._electq = _StrandQueue(
+                stack.offload.strand(), self._elect_one
+            )
+            self._tick_handle = stack.timers.every(
+                self.lease_interval, self._tick_once
+            )
+        else:
+            self._worker = threading.Thread(
+                target=self._work_loop, name=f"mon.{self.rank}.wq",
+                daemon=True,
+            )
+            self._worker.start()
+            self._elector = threading.Thread(
+                target=self._elect_loop, name=f"mon.{self.rank}.elect",
+                daemon=True,
+            )
+            self._elector.start()
+            self._ticker = threading.Thread(
+                target=self._tick_loop, name=f"mon.{self.rank}.tick",
+                daemon=True,
+            )
+            self._ticker.start()
         if self.monmap.size == 1:
             self.state = STATE_LEADER
             self.leader = self.rank
@@ -182,6 +223,8 @@ class QuorumMonitor(Monitor):
         self._stop.set()
         self._workq.put(None)
         self._electq.put(None)
+        if self._tick_handle is not None:
+            self._tick_handle.cancel()
         if self._worker is not None:
             self._worker.join(timeout=5)
         if self._elector is not None:
@@ -744,99 +787,114 @@ class QuorumMonitor(Monitor):
             item = self._workq.get()
             if item is None:
                 return
-            kind = item[0]
-            try:
-                if kind == "command":
-                    reply = self.handle_command(item[2].cmd)
-                    reply.tid = item[2].tid
-                    try:
-                        item[1].send(reply)
-                    except (MessageError, OSError):
-                        pass
-                elif kind == "forward":
-                    self._forward_command(item[1], item[2])
-                elif kind == "base":
-                    try:
-                        if self.monmap.size > 1 and not self.is_leader:
-                            # lost leadership between enqueue and
-                            # processing: hand it to the new leader
-                            self._forward_to_leader(item[2])
-                        else:
-                            super().ms_dispatch(item[1], item[2])
-                    except RuntimeError:
-                        self._forward_to_leader(item[2])
-            except Exception:  # noqa: BLE001 — worker must survive
-                import traceback
+            self._work_one(item)
 
-                traceback.print_exc()
+    def _work_one(self, item) -> None:
+        if self._stop.is_set():
+            return
+        kind = item[0]
+        try:
+            if kind == "command":
+                reply = self.handle_command(item[2].cmd)
+                reply.tid = item[2].tid
+                try:
+                    item[1].send(reply)
+                except (MessageError, OSError):
+                    pass
+            elif kind == "forward":
+                self._forward_command(item[1], item[2])
+            elif kind == "base":
+                try:
+                    if self.monmap.size > 1 and not self.is_leader:
+                        # lost leadership between enqueue and
+                        # processing: hand it to the new leader
+                        self._forward_to_leader(item[2])
+                    else:
+                        super().ms_dispatch(item[1], item[2])
+                except RuntimeError:
+                    self._forward_to_leader(item[2])
+        except Exception:  # noqa: BLE001 — worker must survive
+            import traceback
+
+            traceback.print_exc()
 
     def _elect_loop(self) -> None:
         while not self._stop.is_set():
             item = self._electq.get()
             if item is None:
                 return
-            kind = item[0]
-            try:
-                if kind == "msg":
-                    self._handle_election(item[1], item[2])
-                elif kind == "collect":
-                    self._collect(item[1])
-                elif kind == "election":
-                    self._start_election()
-                elif kind == "sync":
-                    _k, leader, lc = item
-                    if leader >= 0 and leader != self.rank:
-                        self._send_to(
-                            leader,
-                            MMonPaxos(
-                                op=PAXOS_SYNC, rank=self.rank,
-                                last_committed=lc,
-                            ),
-                        )
-            except Exception:  # noqa: BLE001 — elector must survive
-                import traceback
+            self._elect_one(item)
 
-                traceback.print_exc()
+    def _elect_one(self, item) -> None:
+        if self._stop.is_set():
+            return
+        kind = item[0]
+        try:
+            if kind == "msg":
+                self._handle_election(item[1], item[2])
+            elif kind == "collect":
+                self._collect(item[1])
+            elif kind == "election":
+                self._start_election()
+            elif kind == "sync":
+                _k, leader, lc = item
+                if leader >= 0 and leader != self.rank:
+                    self._send_to(
+                        leader,
+                        MMonPaxos(
+                            op=PAXOS_SYNC, rank=self.rank,
+                            last_committed=lc,
+                        ),
+                    )
+        except Exception:  # noqa: BLE001 — elector must survive
+            import traceback
+
+            traceback.print_exc()
 
     def _tick_loop(self) -> None:
         while not self._stop.wait(self.lease_interval):
-            now = time.monotonic()
+            self._tick_once()
+
+    def _tick_once(self) -> None:
+        if self._stop.is_set():
+            return
+        now = time.monotonic()
+        with self._lock:
+            state = self.state
+            epoch = self.election_epoch
+            lc = self.store.last_committed()
+            peons = sorted(self.quorum - {self.rank})
+            since_start = now - self._election_start
+            election_stale = (
+                state == STATE_ELECTING
+                and since_start > self.election_timeout
+            )
+            gather_expired = (
+                state == STATE_ELECTING
+                and since_start > self.election_timeout / 2
+            )
+            lease_dead = (
+                state == STATE_PEON and now > self._lease_expiry
+            )
+        if gather_expired:
+            # majority acked but not everyone: close the gather
+            # window and take the quorum we have
+            self._maybe_win(expired=True)
             with self._lock:
                 state = self.state
-                epoch = self.election_epoch
-                lc = self.store.last_committed()
-                peons = sorted(self.quorum - {self.rank})
-                since_start = now - self._election_start
                 election_stale = (
-                    state == STATE_ELECTING
-                    and since_start > self.election_timeout
+                    state == STATE_ELECTING and election_stale
                 )
-                gather_expired = (
-                    state == STATE_ELECTING
-                    and since_start > self.election_timeout / 2
+        if state == STATE_LEADER:
+            for rank in peons:
+                self._send_to(
+                    rank,
+                    MMonPaxos(
+                        op=PAXOS_LEASE, epoch=epoch,
+                        last_committed=lc, rank=self.rank,
+                    ),
                 )
-                lease_dead = (
-                    state == STATE_PEON and now > self._lease_expiry
-                )
-            if gather_expired:
-                # majority acked but not everyone: close the gather
-                # window and take the quorum we have
-                self._maybe_win(expired=True)
-                with self._lock:
-                    state = self.state
-                    election_stale = (
-                        state == STATE_ELECTING and election_stale
-                    )
-            if state == STATE_LEADER:
-                for rank in peons:
-                    self._send_to(
-                        rank,
-                        MMonPaxos(
-                            op=PAXOS_LEASE, epoch=epoch,
-                            last_committed=lc, rank=self.rank,
-                        ),
-                    )
-            elif election_stale or lease_dead:
-                if self.monmap.size == 1:
-                    continue
-                self._start_election()
+        elif election_stale or lease_dead:
+            if self.monmap.size == 1:
+                return
+            self._start_election()

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import os
 import threading
 import time
 
@@ -45,6 +46,13 @@ from .stack import NetworkStack
 
 BANNER = b"ceph-tpu-msgr/2\n"
 _CALL_TIMEOUT = 30.0
+# bounded inbound dispatch queue (the ms_dispatch_throttle_bytes
+# role, counted in messages): when a messenger's dispatch-strand
+# backlog reaches the high watermark its socket reads PAUSE — TCP
+# flow control pushes back on the senders — and resume once the
+# strand drains to the low watermark.  Messages are never dropped;
+# stalls are counted (l_msgr_dispatch_queue_stalls).
+DISPATCH_QUEUE_HIGH_DEFAULT = 256
 # largest ciphertext a peer may announce in secure mode; generous vs
 # any legitimate message (multi-MB chunk writes) but far below the
 # 4 GiB the u32 prefix could otherwise demand
@@ -306,6 +314,11 @@ class Connection:
                     fut.set_result(msg)
                 else:
                     self.msgr._dispatch(self, msg)
+                    # bounded dispatch queue: past the watermark this
+                    # connection stops reading (TCP pushes back on
+                    # the peer) until the strand drains — backlog is
+                    # bounded without ever dropping a message
+                    await self.msgr._maybe_stall_reads()
         except (
             asyncio.IncompleteReadError,
             ConnectionError,
@@ -378,6 +391,21 @@ class Messenger:
         self._tasks: set = set()
         # dispatch-offload strand (created at start)
         self._dispatch_strand = None
+        # bounded dispatch queue: backlog accounting + the read gate
+        # every read loop of this messenger awaits while stalled
+        self._dispatch_high = max(
+            1,
+            int(
+                os.environ.get(
+                    "CEPH_TPU_MSGR_DISPATCH_HIGH",
+                    DISPATCH_QUEUE_HIGH_DEFAULT,
+                )
+            ),
+        )
+        self._dispatch_low = max(1, self._dispatch_high // 2)
+        self._dispatch_depth = 0
+        self._depth_lock = threading.Lock()
+        self._read_gate: asyncio.Event | None = None
         self._shut = False  # shutdown() is terminal
         self._server: asyncio.AbstractServer | None = None
         self._dispatchers: list[Dispatcher] = []
@@ -460,6 +488,7 @@ class Messenger:
             self._worker = worker
             self._loop = worker.loop
             self._dispatch_strand = stack.offload.strand()
+            self._read_gate = asyncio.Event()
 
     # -- shared-loop task bookkeeping --------------------------------------
     def _track(self, task: asyncio.Task) -> None:
@@ -697,7 +726,68 @@ class Messenger:
         if strand is None:
             # racing shutdown: nobody left to deliver to
             return
-        strand.submit(lambda: self._dispatch_now(conn, msg))
+        with self._depth_lock:
+            self._dispatch_depth += 1
+        stack = self._stack
+        if stack is not None:
+            stack.perf.inc("l_msgr_dispatch_queue_depth")
+
+        def _run_one():
+            try:
+                self._dispatch_now(conn, msg)
+            finally:
+                self._dispatch_done()
+
+        strand.submit(_run_one)
+
+    def _dispatch_done(self) -> None:
+        """Backlog drained by one (offload thread): below the low
+        watermark, reopen this messenger's read gate so stalled
+        socket reads resume."""
+        wake = False
+        with self._depth_lock:
+            self._dispatch_depth -= 1
+            gate = self._read_gate
+            if (
+                gate is not None
+                and self._dispatch_depth <= self._dispatch_low
+                and not gate.is_set()
+            ):
+                wake = True
+        stack = self._stack
+        if stack is not None:
+            stack.perf.dec("l_msgr_dispatch_queue_depth")
+        if wake:
+            loop = self._loop
+            if loop is not None:
+                try:
+                    # Event.set wakes loop futures — loop thread only
+                    loop.call_soon_threadsafe(gate.set)
+                except RuntimeError:
+                    pass  # loop stopping: readers die with it
+
+    @property
+    def dispatch_backlog(self) -> int:
+        with self._depth_lock:
+            return self._dispatch_depth
+
+    async def _maybe_stall_reads(self) -> None:
+        """Read-loop side of the bounded dispatch queue (loop
+        thread): at/over the high watermark, clear the gate and wait
+        for the strand to drain.  Check-and-clear shares the depth
+        lock with _dispatch_done's decrement, so a drain racing this
+        stall can never strand the gate closed with an empty queue."""
+        gate = self._read_gate
+        if gate is None:
+            return
+        with self._depth_lock:
+            if self._dispatch_depth < self._dispatch_high:
+                return
+            gate.clear()
+        stack = self._stack
+        if stack is not None:
+            stack.perf.inc("l_msgr_dispatch_queue_stalls")
+        await gate.wait()
 
     def _dispatch_now(self, conn, msg: Message) -> None:
         # trace propagation (the ZTracer trace-info handoff): a

@@ -111,6 +111,17 @@ def build_stack_perf(n_workers: int):
             "l_msgr_offload_threads_peak",
             "dispatch-offload thread high-water mark",
         )
+        .add_u64_gauge(
+            "l_msgr_dispatch_queue_depth",
+            "inbound messages queued on dispatch strands across "
+            "all messengers",
+        )
+        .add_u64_counter(
+            "l_msgr_dispatch_queue_stalls",
+            "read-loop pauses: a messenger's dispatch backlog "
+            "crossed the high watermark and its socket reads "
+            "stalled until the strand drained",
+        )
     )
     for i in range(n_workers):
         b.add_u64_gauge(

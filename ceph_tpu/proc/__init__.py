@@ -1,0 +1,29 @@
+"""Multi-process cluster runtime — the process model the reference
+deploys (one OS process per daemon, `src/ceph_osd.cc` global_init;
+respawn by ceph-run / systemd `Restart=on-failure`).
+
+Everything in-process stays GIL-bound: PR 14's 100-OSD harness and
+PR 15's sharded-index bench honestly cap at ~1.4x on one core.  This
+package escapes that ceiling:
+
+- ``spec``       the cluster-spec grammar: which daemons, where their
+                 stores live, which ports the mon trio binds — one
+                 JSON document shared by the supervisor and every
+                 child (the ceph.conf seat).
+- ``daemon``     the per-daemon entrypoint
+                 (``python -m ceph_tpu.proc.daemon --role osd.3``):
+                 boots exactly ONE mon/osd/mgr/mds/rgw daemon on the
+                 shared-event-loop stack, publishes a readiness file,
+                 and parks until SIGTERM.  All inter-daemon traffic
+                 rides the messenger's real sockets.
+- ``supervisor`` the ceph-run/systemd role: spawns the fleet as
+                 setsid children with per-child log capture, monitors
+                 them, respawns crashes with exponential backoff and
+                 a crash-loop cap, and feeds every real process death
+                 into the crash-report plane so RECENT_CRASH raises.
+"""
+
+from .spec import ClusterSpec
+from .supervisor import Supervisor, build_proc_perf
+
+__all__ = ["ClusterSpec", "Supervisor", "build_proc_perf"]

@@ -237,6 +237,7 @@ class RGW:
         self.reshard_worker = None
         self._mgr_stop = None
         self._mgr_thread = None
+        self._mgr_handle = None  # shared-services stack timer
         # set by _verify per call: was the last verified identity a
         # temporary (STS) credential?  Read immediately by the STS
         # route to refuse self-renewal (handler threads each verify
@@ -1146,13 +1147,25 @@ class RGW:
         except Exception:  # noqa: BLE001 — telemetry is best-effort
             state["conn"] = None
 
-    def start_mgr_reports(self, interval: float = 1.0) -> None:
+    def start_mgr_reports(
+        self,
+        interval: float = 1.0,
+        shared_services: bool | None = None,
+    ) -> None:
         """Push ``l_rgw_index_*``/``l_rgw_reshard_*`` to the mgr on
-        a timer, like an OSD's stats plane."""
-        if self._mgr_thread is not None:
+        a timer, like an OSD's stats plane.  With ``shared_services``
+        the push rides a shared-stack timer instead of a dedicated
+        thread (the PR 14 treatment)."""
+        if self._mgr_thread is not None or self._mgr_handle is not None:
+            return
+        state: dict = {}
+        if shared_services:
+            stack = self.io.rados.messenger._stack
+            self._mgr_handle = stack.timers.every(
+                interval, lambda: self._mgr_report_once(state)
+            )
             return
         self._mgr_stop = threading.Event()
-        state: dict = {}
 
         def loop():
             while not self._mgr_stop.wait(interval):
@@ -1608,5 +1621,8 @@ class RGW:
             self._mgr_thread.join(timeout=5)
             self._mgr_stop = None
             self._mgr_thread = None
+        if self._mgr_handle is not None:
+            self._mgr_handle.cancel()
+            self._mgr_handle = None
         if self.server is not None:
             self.server.shutdown()

@@ -12,6 +12,13 @@ like vstart's standalone daemons collapsed onto one host) and writes
 ``<dir>/cluster.json`` — mon address, pools, rgw port — which the
 ``ceph``/``rados`` CLIs and librados clients consume:
 
+``start --processes`` instead boots the REAL process model: a mon
+trio + mgr + OSDs (+MDS/RGW), each daemon its own OS process under
+the crash-respawning :class:`~ceph_tpu.proc.Supervisor`, traffic on
+real sockets — vstart the way the reference actually runs, and the
+only mode whose throughput can exceed one core.  ``--mons`` sizes
+the quorum; per-child logs land in ``<dir>/<role>.log``.
+
     python -m ceph_tpu.tools.ceph_cli -m $(ceph-tpu-cluster addr -d /tmp/c1) status
 
 ``--daemonize`` forks into the background with a pidfile so ``stop``
@@ -229,7 +236,125 @@ def _load_conf(d: pathlib.Path) -> dict:
     return json.loads(f.read_text())
 
 
+def _daemonize(args) -> int | None:
+    """Fork into the background with readiness polling.  Returns the
+    parent's exit code, or None in the detached child (which carries
+    on to boot the cluster)."""
+    pid = os.fork()
+    if pid:
+        # parent: wait for the child to report readiness
+        for _ in range(200):
+            if (pathlib.Path(args.dir) / "cluster.json").exists():
+                conf = _load_conf(pathlib.Path(args.dir))
+                print(json.dumps(conf))
+                return 0
+            time.sleep(0.3)
+        print("cluster failed to start", file=sys.stderr)
+        return 1
+    os.setsid()
+    # drop the inherited stdio: a caller capturing our pipes would
+    # otherwise wait forever for EOF the daemon never sends; daemon
+    # output goes to <dir>/cluster.log
+    logdir = pathlib.Path(args.dir)
+    logdir.mkdir(parents=True, exist_ok=True)
+    log = open(logdir / "cluster.log", "ab", buffering=0)
+    devnull = open(os.devnull, "rb")
+    os.dup2(devnull.fileno(), 0)
+    os.dup2(log.fileno(), 1)
+    os.dup2(log.fileno(), 2)
+    return None
+
+
+def _start_processes(args) -> int:
+    """``start --processes``: supervised one-daemon-per-OS-process
+    fleet (the tentpole runtime) behind the same cluster.json
+    contract the thread-hosted mode publishes."""
+    from ..proc import ClusterSpec, Supervisor
+    from ..rados import Rados
+
+    cdir = pathlib.Path(args.dir)
+    # a previous run that died uncleanly may have left daemon
+    # process groups squatting the pinned ports
+    Supervisor.reap_orphans(cdir)
+    spec = ClusterSpec.plan(
+        args.dir,
+        mons=args.mons,
+        osds=args.osds,
+        mgrs=1,
+        mds=args.mds,
+        rgw=args.rgw,
+        memstore=args.memstore,
+        wal=args.wal,
+        mon_port=args.mon_port,
+        rgw_port=args.rgw_port,
+    )
+    sup = Supervisor(spec)
+    sup.start()
+    conf = {
+        "mode": "processes",
+        "mon_addr": list(spec.mon_addrs[0]),
+        "mon_addrs": [list(a) for a in spec.mon_addrs],
+        "osds": int(args.osds),
+        "pools": [],
+        "dir": str(cdir),
+        "pid": os.getpid(),
+    }
+    if args.mds:
+        conf["mds"] = int(args.mds)
+        conf["pools"] += ["fsmeta", "fsdata"]
+    if args.rgw:
+        conf["rgw_port"] = int(spec.data["rgw_ports"][0])
+        conf["pools"].append("rgwpool")
+
+    admin = Rados("cluster-admin").connect_any(spec.mon_addrs)
+    healthy = False
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        rc, outb, _ = admin.mon_command({"prefix": "status"})
+        if rc == 0:
+            st = json.loads(outb)
+            if st["num_up_osds"] == st["num_osds"]:
+                healthy = True
+                break
+        time.sleep(0.3)
+    admin.shutdown()
+
+    tmp = cdir / "cluster.json.tmp"
+    tmp.write_text(json.dumps(conf))
+    os.replace(tmp, cdir / "cluster.json")
+    if not args.daemonize:
+        print(json.dumps(conf))
+        print(
+            f"cluster {'healthy' if healthy else 'DEGRADED'} "
+            f"({len(spec.roles())} processes); Ctrl-C to stop",
+            file=sys.stderr,
+        )
+    stop = {"flag": False}
+
+    def _sig(_s, _f):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.5)
+    finally:
+        sup.stop()
+        try:
+            (cdir / "cluster.json").unlink()
+        except OSError:
+            pass
+    return 0
+
+
 def _cmd_start(args) -> int:
+    if args.daemonize:
+        rc = _daemonize(args)
+        if rc is not None:
+            return rc
+    if args.processes:
+        return _start_processes(args)
     spec = {
         "dir": args.dir,
         "osds": args.osds,
@@ -241,29 +366,6 @@ def _cmd_start(args) -> int:
         "rgw_port": args.rgw_port,
         "shared_services": args.shared_services,
     }
-    if args.daemonize:
-        pid = os.fork()
-        if pid:
-            # parent: wait for the child to report readiness
-            for _ in range(100):
-                if (pathlib.Path(args.dir) / "cluster.json").exists():
-                    conf = _load_conf(pathlib.Path(args.dir))
-                    print(json.dumps(conf))
-                    return 0
-                time.sleep(0.3)
-            print("cluster failed to start", file=sys.stderr)
-            return 1
-        os.setsid()
-        # drop the inherited stdio: a caller capturing our pipes
-        # would otherwise wait forever for EOF the daemon never
-        # sends; daemon output goes to <dir>/cluster.log
-        logdir = pathlib.Path(args.dir)
-        logdir.mkdir(parents=True, exist_ok=True)
-        log = open(logdir / "cluster.log", "ab", buffering=0)
-        devnull = open(os.devnull, "rb")
-        os.dup2(devnull.fileno(), 0)
-        os.dup2(log.fileno(), 1)
-        os.dup2(log.fileno(), 2)
     c = Cluster(spec)
     conf = c.start()
     healthy = c.wait_healthy()
@@ -297,7 +399,13 @@ def _cmd_status(args) -> int:
     msgr = Messenger("cluster-status")
     try:
         monc = MonClient(msgr, whoami=-1)
-        monc.connect(*conf["mon_addr"])
+        if conf.get("mon_addrs"):
+            # multi-mon (--processes): any quorum member answers
+            monc.connect_any(
+                [tuple(a) for a in conf["mon_addrs"]]
+            )
+        else:
+            monc.connect(*conf["mon_addr"])
         reply = monc.command({"prefix": "status"})
         print(reply.outb if reply.rc == 0 else reply.outs)
         return 0 if reply.rc == 0 else 1
@@ -306,19 +414,43 @@ def _cmd_status(args) -> int:
 
 
 def _cmd_stop(args) -> int:
-    conf = _load_conf(pathlib.Path(args.dir))
+    from ..proc import Supervisor
+
+    cdir = pathlib.Path(args.dir)
+    conf = _load_conf(cdir)
     pid = conf.get("pid")
     if pid is None:
         return 1
     try:
-        os.kill(pid, signal.SIGTERM)
+        # the daemonized launcher is a setsid group leader: signal
+        # the whole GROUP, so helpers it forked (and, in --processes
+        # mode, the supervisor thread's machinery) die with it — a
+        # single os.kill used to strand them
+        os.killpg(pid, signal.SIGTERM)
     except ProcessLookupError:
         print("already gone", file=sys.stderr)
-    for _ in range(100):
-        if not (pathlib.Path(args.dir) / "cluster.json").exists():
+    except PermissionError:
+        os.kill(pid, signal.SIGTERM)
+    for _ in range(150):
+        if not (cdir / "cluster.json").exists():
             return 0
         time.sleep(0.2)
-    print("cluster did not stop cleanly", file=sys.stderr)
+    # launcher wedged: reap the recorded daemon process groups
+    # directly, then put the launcher group down hard
+    reaped = Supervisor.reap_orphans(cdir)
+    try:
+        os.killpg(pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    try:
+        (cdir / "cluster.json").unlink()
+    except OSError:
+        pass
+    print(
+        f"cluster did not stop cleanly; force-killed "
+        f"(reaped {len(reaped)} daemon groups)",
+        file=sys.stderr,
+    )
     return 1
 
 
@@ -347,6 +479,16 @@ def main(argv=None) -> int:
         "--shared-services", action="store_true",
         help="OSD tick/report/op-queue on the shared network "
         "stack (zero per-daemon threads; for large --osds)",
+    )
+    sp.add_argument(
+        "--processes", "-P", action="store_true",
+        help="one OS process per daemon under the crash-respawning "
+        "supervisor (real mon quorum, real sockets, scales past "
+        "one core)",
+    )
+    sp.add_argument(
+        "--mons", type=int, default=3,
+        help="monitor quorum size (--processes mode only)",
     )
     sp.add_argument("--mon-port", type=int, default=0)
     sp.add_argument("--rgw-port", type=int, default=0)

@@ -27,6 +27,11 @@ acceptance criteria, and tears everything down:
   WAL-fronted OSD mid small-write storm: PG_DEGRADED raises, the
   restart replays the log (nonzero replayed records), the check
   clears, and zero acknowledged writes are lost byte-for-byte.
+- ``scenario_kill_daemon_process``  the same storm against a fully
+  multi-process SUPERVISED cluster: the supervisor itself respawns
+  the SIGKILLed OSD (WAL replayed), the death rides MMgrReport into
+  RECENT_CRASH as a ProcessDeath report, ``crash archive all``
+  clears it, and zero acknowledged writes are lost.
 
 pytest drives these from tests/test_chaos.py (multi-second scenarios
 carry the ``slow`` marker there); ``python tests/chaos.py [name ...]``
@@ -1182,6 +1187,225 @@ def scenario_kill_storm_wal(seed: int = DEFAULT_SEED) -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def scenario_kill_daemon_process(seed: int = DEFAULT_SEED) -> dict:
+    """The supervisor crash gate (ISSUE 19): a fully multi-process
+    cluster — 3-mon quorum, mgr, 4 WAL-fronted OSDs, every daemon its
+    own OS process under the crash-respawning Supervisor — takes a 4k
+    small-write storm while one OSD process is SIGKILLed.  Asserts
+    the whole death-to-heal arc: PG_DEGRADED raises with a nonzero
+    degraded count; the SUPERVISOR (not the test) respawns the victim
+    and the respawn REPLAYS its WAL (nonzero replayed records in the
+    readiness report); the death reaches RECENT_CRASH as a
+    ProcessDeath report naming SIGKILL; `crash archive all` clears
+    the check; PG_DEGRADED drains to zero; and ZERO acknowledged
+    writes are lost byte-for-byte."""
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    from ceph_tpu.msg.message import MMonCommand
+    from ceph_tpu.proc import ClusterSpec, Supervisor
+
+    victim = "osd.2"
+    victim_id = 2
+    obj = 4096
+    workdir = tempfile.mkdtemp(prefix="chaos-proc-")
+    sup = None
+    client = None
+    try:
+        spec = ClusterSpec.plan(
+            workdir, mons=3, osds=4, mgrs=1, memstore=True, wal=True
+        )
+        # backoff_base outlasts the heartbeat grace on purpose: an
+        # instant respawn would resurrect the victim before the mon
+        # ever marks it down, and the degraded window under test
+        # would never open
+        sup = Supervisor(spec, min_uptime=0.5, backoff_base=6.0)
+        sup.start(ready_timeout=90)
+
+        client = Rados("chaos-proc").connect_any(spec.mon_addrs)
+        client.objecter.op_timeout = 30.0
+        client.pool_create("procstorm", pg_num=8, size=3, min_size=2)
+        io = client.open_ioctx("procstorm")
+
+        # the storm: unique 4k oids, acked oracle recorded AFTER each
+        # ack returns — exactly the set the respawn must preserve
+        stop = threading.Event()
+        acked: dict[str, bytes] = {}
+        errors: list[str] = []
+        llock = threading.Lock()
+
+        def storm():
+            i = 0
+            while not stop.is_set():
+                oid = f"storm-{i}"
+                data = bytes([1 + i % 255]) * obj
+                try:
+                    io.write_full(oid, data)
+                    with llock:
+                        acked[oid] = data
+                except RadosError as e:
+                    errors.append(str(e))
+                i += 1
+                time.sleep(0.01)
+
+        t = threading.Thread(target=storm, daemon=True)
+        t.start()
+        time.sleep(1.5)  # build a deferred WAL backlog in the victim
+
+        # SIGKILL the victim PROCESS: no flush, no drain, no goodbye
+        old_pid = sup.kill(victim, _signal.SIGKILL)
+        with llock:
+            acked_at_kill = len(acked)
+        assert wait_for(
+            lambda: not client.monc.osdmap.is_up(victim_id), 20.0
+        ), "mon never marked the killed victim down"
+
+        # verdict 1: the kill raises PG_DEGRADED with nonzero count
+        degraded_peak = [0]
+
+        def degraded_visible():
+            rc2, outb, _o = client.mon_command({"prefix": "status"})
+            if rc2 != 0:
+                return False
+            data = json.loads(outb).get("pgmap", {}).get("data", {})
+            degraded_peak[0] = max(
+                degraded_peak[0], int(data.get("degraded", 0))
+            )
+            rc2, outb, _o = client.mon_command({"prefix": "health"})
+            return (
+                rc2 == 0
+                and degraded_peak[0] > 0
+                and "PG_DEGRADED"
+                in json.loads(outb).get("checks_detail", {})
+            )
+
+        assert wait_for(degraded_visible, 20.0), (
+            "PG_DEGRADED never raised after the process kill"
+        )
+
+        # verdict 2: the SUPERVISOR respawns the victim (new pid,
+        # restart counted) and the respawn replays the WAL
+        def respawned():
+            st = sup.status()[victim]
+            return (
+                st["state"] == "running"
+                and st["pid"] != old_pid
+                and st["restarts"] >= 1
+            )
+
+        assert wait_for(respawned, 30.0), sup.status()[victim]
+        sup.wait_ready([victim], timeout=60)
+        replayed = int(sup.ready_info(victim)["replayed"])
+        assert replayed > 0, (
+            "respawn replayed nothing — the SIGKILL never caught a "
+            "deferred WAL backlog"
+        )
+        assert wait_for(
+            lambda: client.monc.osdmap.is_up(victim_id), 30.0
+        ), "respawned victim never rejoined the map"
+
+        # write INTO the degraded window, then stop the storm
+        time.sleep(1.0)
+        stop.set()
+        t.join(timeout=20)
+        assert acked, "storm acked nothing"
+
+        # verdict 3: the death rode MMgrReport into RECENT_CRASH as a
+        # ProcessDeath report naming the signal
+        def crash_raised():
+            rc2, outb, _o = client.mon_command({"prefix": "health"})
+            return rc2 == 0 and "RECENT_CRASH" in json.loads(
+                outb
+            ).get("checks_detail", {})
+
+        assert wait_for(crash_raised, 30.0), (
+            "RECENT_CRASH never raised for the process death"
+        )
+        rc2, outb, _o = client.mon_command({"prefix": "mgr stat"})
+        assert rc2 == 0
+        host, _, port = json.loads(outb)["active"]["addr"].rpartition(
+            ":"
+        )
+        mgr_conn = client.messenger.connect(host, int(port))
+        rows = json.loads(
+            mgr_conn.call(
+                MMonCommand(cmd=json.dumps({"prefix": "crash ls"}))
+            ).outb
+        )
+        ours = [
+            r
+            for r in rows
+            if r["entity_name"] == victim
+            and "SIGKILL" in r["exception"]
+        ]
+        assert ours, f"no ProcessDeath crash for {victim}: {rows}"
+
+        # verdict 4: the heal clears PG_DEGRADED and drains the count
+        def quiet():
+            rc3, outb3, _o = client.mon_command({"prefix": "health"})
+            if rc3 != 0 or "PG_DEGRADED" in json.loads(outb3).get(
+                "checks_detail", {}
+            ):
+                return False
+            rc3, outb3, _o = client.mon_command({"prefix": "status"})
+            if rc3 != 0:
+                return False
+            data = json.loads(outb3).get("pgmap", {}).get("data", {})
+            return int(data.get("degraded", 0)) == 0
+
+        assert wait_for(quiet, 60.0), (
+            "PG_DEGRADED never cleared after the respawn + re-peer"
+        )
+
+        # archiving the death clears RECENT_CRASH (operator ack path)
+        reply = mgr_conn.call(
+            MMonCommand(
+                cmd=json.dumps(
+                    {"prefix": "crash archive", "id": "all"}
+                )
+            )
+        )
+        assert reply.rc == 0, reply.outs
+
+        def crash_cleared():
+            rc3, outb3, _o = client.mon_command({"prefix": "health"})
+            return rc3 == 0 and "RECENT_CRASH" not in json.loads(
+                outb3
+            ).get("checks_detail", {})
+
+        assert wait_for(crash_cleared, 20.0), (
+            "RECENT_CRASH never cleared after crash archive all"
+        )
+
+        # verdict 5: zero acked-write loss, byte-identical
+        lost = 0
+        for oid, data in sorted(acked.items()):
+            got = io.read(oid)
+            assert got == data, f"acked write {oid} diverged"
+            lost += got != data
+        assert lost == 0
+
+        return {
+            "seed": seed,
+            "processes": len(spec.roles()),
+            "acked_writes": len(acked),
+            "writes_after_kill": len(acked) - acked_at_kill,
+            "replayed_records": replayed,
+            "degraded_peak": degraded_peak[0],
+            "supervisor_restarts": sup.status()[victim]["restarts"],
+            "recent_crash_raised": True,
+            "recent_crash_cleared": True,
+            "client_errors": len(errors),
+        }
+    finally:
+        if client is not None:
+            client.shutdown()
+        if sup is not None:
+            sup.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 SCENARIOS = {
     "mon_netsplit": scenario_mon_netsplit,
     "asymmetric_partition": scenario_asymmetric_partition,
@@ -1189,6 +1413,7 @@ SCENARIOS = {
     "fill_to_full": scenario_fill_to_full,
     "kill_osd_at_fill": scenario_kill_osd_at_fill,
     "kill_storm_wal": scenario_kill_storm_wal,
+    "kill_daemon_process": scenario_kill_daemon_process,
 }
 
 

@@ -63,6 +63,51 @@ def _isolate_global_crash_queue():
     _crash.reset_throttle()
 
 
+# The multi-process runtime (ceph_tpu/proc) spawns one OS process per
+# daemon.  A test that fails mid-scenario can strand children that
+# squat ports and CPU for the rest of the run: reap any daemon
+# process that is still OUR descendant after each test.  (Scoped to
+# the daemon entrypoint cmdline — never touches unrelated processes.)
+def _leaked_daemon_pids() -> list[int]:
+    import pathlib
+
+    me = os.getpid()
+    out = []
+    for p in pathlib.Path("/proc").iterdir():
+        if not p.name.isdigit():
+            continue
+        try:
+            cmdline = (p / "cmdline").read_bytes()
+            if b"ceph_tpu.proc.daemon" not in cmdline:
+                continue
+            stat = (p / "stat").read_text().rsplit(")", 1)[1].split()
+            ppid = int(stat[1])
+        except (OSError, IndexError, ValueError):
+            continue
+        # direct children only: setsid daemons reparent to init when
+        # their supervisor dies, but their recorded parent at spawn
+        # is the test process — either way the cmdline match plus
+        # (ppid == us or orphaned) marks them leaked
+        if ppid == me or ppid == 1:
+            out.append(int(p.name))
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _reap_leaked_daemon_processes():
+    yield
+    import signal as _signal
+
+    for pid in _leaked_daemon_pids():
+        try:
+            os.killpg(pid, _signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+
 # Round-5 loosened several wall-clock assertions because loaded CI
 # boxes missed them; the strict bounds still catch real regressions
 # whenever the box is actually idle.  Tests pick their bound at

@@ -292,3 +292,14 @@ def test_scenario_kill_storm_wal():
     assert result["pg_degraded_raised"]
     assert result["pg_degraded_cleared"]
     assert result["degraded_peak"] > 0
+
+
+@pytest.mark.slow
+def test_scenario_kill_daemon_process():
+    result = chaos.scenario_kill_daemon_process()
+    assert result["replayed_records"] > 0
+    assert result["supervisor_restarts"] >= 1
+    assert result["degraded_peak"] > 0
+    assert result["recent_crash_raised"]
+    assert result["recent_crash_cleared"]
+    assert result["writes_after_kill"] > 0

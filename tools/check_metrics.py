@@ -161,6 +161,21 @@ WAL_COUNTERS = (
     "l_os_wal_pending_records",
     "l_os_wal_pending_bytes",
 )
+# process-runtime counters the supervisor schema must declare
+# (proc/supervisor.py build_proc_perf — the respawn/crash-loop
+# telemetry riding MMgrReport like every daemon's), and the dispatch
+# backpressure pair the STACK schema must declare (msg/stack.py
+# build_stack_perf — depth gauge + stall counter the bounded inbound
+# queue maintains)
+PROC_COUNTERS = (
+    "l_proc_children",
+    "l_proc_restarts",
+    "l_proc_crash_loops",
+)
+DISPATCH_QUEUE_COUNTERS = (
+    "l_msgr_dispatch_queue_depth",
+    "l_msgr_dispatch_queue_stalls",
+)
 # recovery-storm counters the OSD schema must declare (the
 # l_osd_recovery_* block: batched decode rebuild progress + the
 # survivor-read fan-in the LRC locality claim is measured from)
@@ -428,6 +443,30 @@ def check_worker_counters() -> list[str]:
             for tmpl in WORKER_PER_INDEX_COUNTERS
             if tmpl.format(i=i) not in declared
         )
+    return errors
+
+
+def check_proc_counters() -> list[str]:
+    """The process runtime: build_proc_perf must keep declaring the
+    l_proc_* family, and build_stack_perf the dispatch-backpressure
+    pair — the supervisor tests, the chaos process-kill scenario,
+    and the mgr exporter read exactly these."""
+    from ceph_tpu.msg.stack import build_stack_perf
+    from ceph_tpu.proc.supervisor import build_proc_perf
+
+    errors = []
+    declared = set(build_proc_perf()._counters)
+    errors.extend(
+        f"proc schema: counter {name!r} missing"
+        for name in PROC_COUNTERS
+        if name not in declared
+    )
+    stack_declared = set(build_stack_perf(1)._counters)
+    errors.extend(
+        f"stack schema: dispatch-queue counter {name!r} missing"
+        for name in DISPATCH_QUEUE_COUNTERS
+        if name not in stack_declared
+    )
     return errors
 
 
@@ -892,6 +931,7 @@ def product_counter_sets():
     from ceph_tpu.ops.kernel_stats import KernelStats
     from ceph_tpu.osd.daemon import build_osd_perf
     from ceph_tpu.osd.mapping import _build_perf as build_mapping_perf
+    from ceph_tpu.proc.supervisor import build_proc_perf
     from ceph_tpu.rgw.index import build_rgw_perf
     from ceph_tpu.store.wal_store import build_wal_perf
 
@@ -916,6 +956,7 @@ def product_counter_sets():
         build_stack_perf(default_workers()),
         build_rgw_perf("rgw"),
         build_wal_perf(),
+        build_proc_perf(),
     ]
 
 
@@ -945,6 +986,7 @@ def check_all(sets=None) -> list[str]:
         errors.extend(check_worker_counters())
         errors.extend(check_residency_counters())
         errors.extend(check_dispatch_counters())
+        errors.extend(check_proc_counters())
         errors.extend(check_recovery_counters())
         errors.extend(check_rgw_counters())
         errors.extend(check_wal_counters())
