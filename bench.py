@@ -1916,6 +1916,41 @@ def measure_procs() -> dict:
     }
 
 
+def measure_thrash() -> dict:
+    """qa thrasher section (ISSUE 20): one short fixed-seed composed-
+    fault schedule against a live in-process 3-OSD cluster under the
+    consistency oracle — the artifact carries the weather survived
+    (events applied, client ops checked, violations: must be 0) and
+    the wall cost of the run.  Entirely CPU-side."""
+    import time as _time
+
+    from ceph_tpu.qa import Schedule
+    from ceph_tpu.qa.thrasher import Thrasher
+
+    seed = 20260807
+    sched = Schedule.from_seed(seed, duration=12.0, osds=3)
+    t0 = _time.monotonic()
+    thr = Thrasher(sched, convergence_timeout=45.0)
+    report = thr.run()
+    wall = _time.monotonic() - t0
+    _log(
+        f"thrash seed={seed}: {report['events_applied']}/"
+        f"{report['events']} events, {report['ops']} client ops, "
+        f"{len(report['violations'])} violations, "
+        f"converged={report['converged']}, {wall:.1f}s wall"
+    )
+    return {
+        "thrash_seed": seed,
+        "thrash_events": report["events"],
+        "thrash_events_applied": report["events_applied"],
+        "thrash_ops": report["ops"],
+        "thrash_op_errors": report["op_errors"],
+        "thrash_violations": len(report["violations"]),
+        "thrash_converged": report["converged"],
+        "thrash_wall_s": round(wall, 1),
+    }
+
+
 def measure_recovery(on_tpu: bool) -> dict:
     """Recovery-storm plane (ROADMAP open item 2): decode-from-
     survivors rebuild throughput before/after the coalesced batched
@@ -2460,6 +2495,15 @@ def main(argv=None) -> None:
 
             traceback.print_exc()
             out["procs_error"] = f"{type(e).__name__}: {e}"
+        # chaos thrash under the consistency oracle (ISSUE 20): one
+        # short fixed-seed schedule — violations must stay 0
+        try:
+            out.update(measure_thrash())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            traceback.print_exc()
+            out["thrash_error"] = f"{type(e).__name__}: {e}"
         if be != "none":
             # families BEFORE the big crush compiles: the remote
             # compile service degrades late in a long session, and

@@ -129,7 +129,12 @@ class FaultInjector:
     `ceph tell`, test drivers) — ``_mut_lock`` guards it so ``plan``
     never iterates a container mid-mutation."""
 
-    def __init__(self, name: str, seed: int | None = None):
+    def __init__(
+        self,
+        name: str,
+        seed: int | None = None,
+        rng: Random | None = None,
+    ):
         self.name = name
         self._mut_lock = threading.Lock()
         self._rule_seq = itertools.count(1)
@@ -144,17 +149,25 @@ class FaultInjector:
         self.perf = build_msgr_perf(name)
         # bounded decision trace — the replay-determinism witness
         self.decisions: deque = deque(maxlen=512)
-        self.reseed(seed)
+        self.reseed(seed, rng=rng)
 
     # -- configuration ------------------------------------------------------
-    def reseed(self, seed: int | None = None) -> None:
+    def reseed(
+        self, seed: int | None = None, rng: Random | None = None
+    ) -> None:
         """Pin the decision stream.  The messenger name folds into
         the seed so every daemon draws an independent but
-        reproducible stream from one cluster-wide seed."""
+        reproducible stream from one cluster-wide seed.  A harness
+        that wants to OWN the stream (the qa thrasher's
+        single-source-of-randomness contract) can inject its
+        ``rng`` instead; there is deliberately no module-global
+        fallback anywhere in this file."""
         base = 0 if seed is None else int(seed)
         self.seed = base
-        self._rng = Random(
-            (base << 32) ^ zlib.crc32(self.name.encode())
+        self._rng = (
+            rng
+            if rng is not None
+            else Random((base << 32) ^ zlib.crc32(self.name.encode()))
         )
         self.decisions.clear()
 

@@ -39,6 +39,7 @@ import concurrent.futures
 import os
 import threading
 import time
+import weakref
 
 from .faults import FaultInjector
 from .message import Message, MessageError
@@ -367,6 +368,12 @@ class Messenger:
     auth).  Both None = AUTH_NONE, the reference's
     auth_cluster_required=none mode (AuthRegistry negotiation)."""
 
+    # every live messenger, weakly held — the fault-plane janitor
+    # (tests/conftest.py) sweeps leaked rules/partitions off every
+    # surviving instance between tests so one test's chaos cannot
+    # shadow-fail the next
+    _live: "weakref.WeakSet[Messenger]" = weakref.WeakSet()
+
     def __init__(
         self,
         name: str = "client",
@@ -422,6 +429,7 @@ class Messenger:
         # fault-injection plane (msg/faults.py): netem-style rules,
         # partitions, and the legacy ms_inject_socket_failures knob
         self.faults = FaultInjector(name)
+        Messenger._live.add(self)
 
     @property
     def inject_socket_failures(self) -> int:

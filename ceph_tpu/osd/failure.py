@@ -51,6 +51,14 @@ class HeartbeatTracker:
                 out.append((osd, silent))
         return out
 
+    def reset(self, now: float) -> None:
+        """Thrash-heal hook: forgive all accumulated silence (every
+        peer counts as freshly heard).  After a partition heals, the
+        stale rx stamps would otherwise keep reporting peers that are
+        in fact fine until a ping happens to land."""
+        for osd in self._last_rx:
+            self._last_rx[osd] = now
+
 
 @dataclass
 class _Pending:
@@ -139,3 +147,10 @@ class FailureAggregator:
 
     def pending_reports(self) -> dict[int, int]:
         return {t: len(p.reporters) for t, p in self._pending.items()}
+
+    def reset(self) -> None:
+        """Thrash-heal hook: drop every half-counted report.  A
+        healed partition leaves reporter sets one short of threshold;
+        an unrelated later report must not tip a healthy OSD down on
+        those stale counts."""
+        self._pending.clear()

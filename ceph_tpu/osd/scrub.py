@@ -544,6 +544,27 @@ class Scrubber:
         self.pending[pgid] = (deep or repair or prev[0],
                               repair or prev[1])
 
+    def request_random(
+        self, rng, deep: bool = False, repair: bool = False
+    ) -> str | None:
+        """Thrash hook: order a scrub on one caller-seeded-random PG
+        this OSD currently leads (scrub-during-fault composition).
+        ``rng`` is the caller's ``random.Random`` so target picks sit
+        on the schedule's deterministic stream, not module state.
+        Returns the chosen pgid, or None when nothing is eligible."""
+        osd = self.osd
+        with osd._pg_lock:
+            eligible = sorted(
+                pg.pgid
+                for pg in osd.pgs.values()
+                if pg.primary == osd.whoami and pg.state == "active"
+            )
+        if not eligible:
+            return None
+        pgid = eligible[rng.randrange(len(eligible))]
+        self.request(pgid, deep=deep, repair=repair)
+        return pgid
+
     def due(self, now: float) -> list[tuple[str, bool, bool]]:
         """(pgid, deep, repair) runs the tick should enqueue."""
         osd = self.osd

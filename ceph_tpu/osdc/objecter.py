@@ -58,6 +58,21 @@ def object_to_pg(pool, oid: str) -> str:
     return f"{pool.pool_id}.{ps}"
 
 
+def build_objecter_perf(name: str = "objecter"):
+    """Client-side op-path counters (the objecter block of
+    ``perf dump``), linted by tools/check_metrics.py."""
+    from ..common.perf_counters import PerfCountersBuilder
+
+    return (
+        PerfCountersBuilder(name)
+        .add_u64_counter(
+            "l_objecter_backoff_parks",
+            "ops parked at least once on an MOSDBackoff BLOCK",
+        )
+        .create_perf_counters()
+    )
+
+
 class Objecter(Dispatcher):
     def __init__(self, monc, messenger: Messenger, op_timeout: float = 15.0):
         self.monc = monc
@@ -69,7 +84,7 @@ class Objecter(Dispatcher):
         # resending; UNBLOCK (or a primary change) releases them
         self._backoffs: dict[str, dict] = {}
         self._backoff_lock = threading.Lock()
-        self.backoff_parks = 0  # ops that parked at least once
+        self.perf = build_objecter_perf()
         messenger.add_dispatcher(self)  # UNBLOCK arrives un-paired
         # osd_reqid_t role: a stable id per logical op so retries are
         # deduped by the primary (append idempotency)
@@ -192,7 +207,7 @@ class Objecter(Dispatcher):
         session backoffs on map change), a bounded re-probe, or the
         op deadline.  No sends happen while parked — that is the
         whole point (no futile resend storm)."""
-        self.backoff_parks += 1
+        self.perf.inc("l_objecter_backoff_parks")
         recheck = time.monotonic() + self.BACKOFF_RECHECK
         while time.monotonic() < deadline:
             if time.monotonic() >= recheck:
@@ -219,6 +234,12 @@ class Objecter(Dispatcher):
         # the OSD may no longer hold
         with self._backoff_lock:
             self._backoffs.pop(pgid, None)
+
+    @property
+    def backoff_parks(self) -> int:
+        """Compat view over the real counter (the historical int
+        attribute predates the perf block)."""
+        return int(self.perf.dump()["l_objecter_backoff_parks"])
 
     def dump_backoffs(self) -> list[dict]:
         """Client-side `dump_backoffs` (objecter_requests' backoff

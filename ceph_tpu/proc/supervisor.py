@@ -84,6 +84,9 @@ class _Child:
         self.restarts = 0
         self.state = "new"
         self.respawn_at = 0.0
+        # kill-on-request: the next death is deliberate — park in
+        # "held" instead of the backoff/respawn path until respawn()
+        self.hold = False
 
     @property
     def pid(self) -> int | None:
@@ -288,6 +291,29 @@ class Supervisor:
             self._write_state()
             return
         uptime = time.monotonic() - child.spawned_at
+        if child.hold:
+            # a requested kill: deliberate chaos, not a crash loop.
+            # Still reported (a SIGKILL is a SIGKILL — telemetry does
+            # not launder intent) but parked until respawn() instead
+            # of riding the backoff path.
+            child.state = "held"
+            report = crash_util.build_process_report(
+                child.role,
+                rc,
+                log_tail=self._log_tail(child.role),
+                extra_meta={
+                    "pid": child.pid,
+                    "uptime_s": round(uptime, 3),
+                    "requested": True,
+                },
+            )
+            with self._outbox_lock:
+                self._crash_outbox.append(
+                    (report, CRASH_RESEND_COUNT)
+                )
+            self._write_state()
+            self._push_report()
+            return
         if uptime < self.min_uptime:
             child.consecutive_crashes += 1
         else:
@@ -406,15 +432,41 @@ class Supervisor:
         return self._monc
 
     # -- chaos / introspection ----------------------------------------------
-    def kill(self, role: str, sig: int = signal.SIGKILL) -> int:
+    def kill(
+        self, role: str, sig: int = signal.SIGKILL, hold: bool = False
+    ) -> int:
         """Deliver a REAL signal to a child (chaos hook).  Returns
-        the pid that was signalled."""
+        the pid that was signalled.  ``hold=True`` is the
+        kill-on-request contract: the death parks the child in
+        "held" (no backoff, no auto-respawn) until ``respawn()`` —
+        the thrasher owns the revive timing, not the backoff
+        schedule."""
         child = self.children[role]
         pid = child.pid
         if pid is None:
             raise RuntimeError(f"{role} not running")
+        child.hold = bool(hold)
         os.kill(pid, sig)
         return pid
+
+    def respawn(self, role: str) -> int | None:
+        """Bring a held (or failed/exited/backoff) child back NOW,
+        clearing the hold and the crash-loop count — a requested
+        revive is a fresh start, not restart N of a loop.  Returns
+        the new pid (None when the child was already running)."""
+        child = self.children[role]
+        child.hold = False
+        if child.state == "running" and child.proc is not None:
+            if child.proc.poll() is None:
+                return None
+            # raced a death the monitor loop has not seen yet: fall
+            # through and spawn over it
+        child.consecutive_crashes = 0
+        child.restarts += 1
+        self.perf.inc("l_proc_restarts")
+        self._spawn(child)
+        self._write_state()
+        return child.pid
 
     def status(self) -> dict:
         with self._lock:

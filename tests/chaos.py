@@ -54,43 +54,18 @@ from ceph_tpu.rados import Rados, RadosError  # noqa: E402
 
 DEFAULT_SEED = 20260803
 
-
-# -- plumbing ---------------------------------------------------------------
-def addr_str(addr) -> str:
-    host, port = addr
-    return f"{host}:{port}"
-
-
-def install_aliases(messengers, aliases: dict[str, str]) -> None:
-    """Teach every injector the daemon-name → address map so rules
-    and partitions can say ``osd.1`` / ``mon.2``."""
-    for m in messengers:
-        for name, addr in aliases.items():
-            m.faults.alias(name, addr)
-
-
-def install_partition(
-    messengers, groups, aliases, name="netsplit", seed=DEFAULT_SEED
-) -> None:
-    """One symmetric netsplit: the same named partition (and seed) on
-    every member messenger."""
-    for m in messengers:
-        m.faults.reseed(seed)
-    install_aliases(messengers, aliases)
-    for m in messengers:
-        m.faults.set_partition(name, groups)
-
-
-def heal(messengers, name: str | None = None) -> None:
-    for m in messengers:
-        if name is not None:
-            m.faults.clear_partition(name)
-        else:
-            m.faults.clear()
-
-
-def fault_counters(messenger) -> dict:
-    return messenger.faults.perf.dump()
+# fault-plane plumbing now lives with the thrasher (ceph_tpu/qa):
+# the scenarios here are thin compositions of the SAME primitives the
+# randomized schedules execute, so a hand-scripted netsplit and a
+# generated one cannot drift apart
+from ceph_tpu.qa.thrasher import (  # noqa: E402
+    addr_str,
+    fault_counters,
+    heal,
+    install_aliases,
+    install_lossy,
+    install_partition,
+)
 
 
 # -- scenario 1: majority/minority monitor netsplit -------------------------
@@ -361,13 +336,16 @@ def _lossy_run(seed: int, n_ops: int = 12):
 
         cm = client.messenger
         cm.faults.reseed(seed)
-        for i, osd in c.osds.items():
-            cm.faults.alias(f"osd.{i}", addr_str(osd.addr))
-        # no drops: nothing times out, so the send sequence is a pure
-        # function of the op sequence and the trace replays exactly
+        install_aliases(
+            [cm],
+            {
+                f"osd.{i}": addr_str(osd.addr)
+                for i, osd in c.osds.items()
+            },
+        )
         for i in range(3):
-            cm.faults.add_rule(
-                dst=f"osd.{i}", delay=0.02, jitter=0.03, dup=0.4
+            install_lossy(
+                cm, f"osd.{i}", delay=0.02, jitter=0.03, dup=0.4
             )
         for k in range(n_ops):
             io.write_full(f"lossy-{k}", bytes([k + 1]) * 600)

@@ -108,6 +108,27 @@ def _reap_leaked_daemon_processes():
                 pass
 
 
+# The fault plane (msg/faults.py) lives on every messenger, and chaos
+# tests legitimately leave rules/partitions behind when they fail
+# mid-scenario.  Messengers can outlive their test (module-scoped
+# fixtures, leaked references), so — same shape as the daemon reaper
+# above — sweep every surviving injector clean between tests: one
+# test's netsplit must not shadow-fail the next test's I/O.
+@pytest.fixture(autouse=True)
+def _clear_leaked_fault_rules():
+    yield
+    from ceph_tpu.msg.messenger import Messenger as _Messenger
+
+    for m in list(_Messenger._live):
+        try:
+            f = m.faults
+            if f.active:
+                f.clear()
+            f.socket_failure_every = 0
+        except Exception:  # noqa: BLE001 — mid-shutdown messengers
+            pass
+
+
 # Round-5 loosened several wall-clock assertions because loaded CI
 # boxes missed them; the strict bounds still catch real regressions
 # whenever the box is actually idle.  Tests pick their bound at

@@ -1,0 +1,337 @@
+"""qa plane unit tests — the consistency oracle on HAND-BUILT
+histories (every verdict provoked deliberately, no cluster), the
+seed-deterministic schedule generator, and the ddmin shrinker on a
+synthetic run function.  The live-thrash integration gates live in
+tests/test_qa_thrasher.py."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from ceph_tpu.qa import (
+    ConsistencyOracle,
+    Schedule,
+    ScheduleEvent,
+    shrink_events,
+    write_repro,
+)
+from ceph_tpu.qa.oracle import encode_payload, parse_payload
+from ceph_tpu.qa.shrink import load_repro
+from ceph_tpu.qa.thrasher import build_thrash_perf
+
+
+# -- payload codec ----------------------------------------------------------
+def test_payload_codec_roundtrip_and_corruption():
+    data = encode_payload("qa-c0-o1", 7, 512)
+    assert len(data) == 512
+    ver, ok = parse_payload(data)
+    assert (ver, ok) == (7, True)
+    # deterministic: same (oid, version, size) -> same bytes
+    assert data == encode_payload("qa-c0-o1", 7, 512)
+    # one flipped byte in the filler is caught
+    corrupt = data[:-1] + bytes([data[-1] ^ 0xFF])
+    ver, ok = parse_payload(corrupt)
+    assert (ver, ok) == (7, False)
+    assert parse_payload(b"not a payload") == (None, False)
+
+
+# -- oracle verdicts on hand-built histories --------------------------------
+def kinds(oracle) -> list[str]:
+    return [v.kind for v in oracle.violations]
+
+
+def test_durable_history_is_clean():
+    o = ConsistencyOracle()
+    o.note_mutation("c", "a", 1, acked=True)
+    assert o.note_read("c", "a", 1) is None
+    o.note_mutation("c", "a", 2, acked=True)
+    assert o.note_read("c", "a", 2) is None
+    o.note_mutation("c", "a", 3, acked=True, delete=True)
+    assert o.note_read("c", "a", None) is None
+    assert kinds(o) == []
+
+
+def test_lost_acked_write_fires():
+    o = ConsistencyOracle()
+    o.note_mutation("c", "a", 1, acked=True)
+    v = o.note_read("c", "a", None)  # absent after an ack
+    assert v is not None and v.kind == "lost_acked_write"
+    assert kinds(o) == ["lost_acked_write"]
+
+
+def test_stale_read_fires():
+    o = ConsistencyOracle()
+    o.note_mutation("c", "a", 1, acked=True)
+    o.note_mutation("c", "a", 2, acked=True)
+    v = o.note_read("c", "a", 1)  # older than the proven state
+    assert v is not None and v.kind == "stale_read"
+
+
+def test_resurrected_delete_fires():
+    o = ConsistencyOracle()
+    o.note_mutation("c", "a", 1, acked=True)
+    o.note_mutation("c", "a", 2, acked=True, delete=True)
+    v = o.note_read("c", "a", 1)  # data back from before the delete
+    assert v is not None and v.kind == "resurrected_delete"
+
+
+def test_phantom_version_fires():
+    o = ConsistencyOracle()
+    o.note_mutation("c", "a", 1, acked=True)
+    v = o.note_read("c", "a", 5)  # never issued
+    assert v is not None and v.kind == "phantom_version"
+    # a delete's version observed AS DATA is equally impossible
+    o2 = ConsistencyOracle()
+    o2.note_mutation("c", "a", 1, acked=True, delete=True)
+    v = o2.note_read("c", "a", 1)
+    assert v is not None and v.kind == "phantom_version"
+
+
+def test_corrupt_payload_fires():
+    o = ConsistencyOracle()
+    o.note_mutation("c", "a", 1, acked=True)
+    v = o.note_read("c", "a", 1, payload_ok=False)
+    assert v is not None and v.kind == "corrupt_payload"
+
+
+def test_indeterminate_write_both_outcomes_permitted():
+    o = ConsistencyOracle()
+    o.note_mutation("c", "a", 1, acked=True)
+    o.note_mutation("c", "a", 2, acked=False)  # ack lost mid-fault
+    # landed or not — neither read is a violation
+    assert o.note_read("c", "a", 1) is None
+    assert kinds(o) == []
+
+
+def test_observation_collapses_indeterminacy():
+    o = ConsistencyOracle()
+    o.note_mutation("c", "a", 1, acked=True)
+    o.note_mutation("c", "a", 2, acked=False)
+    assert o.note_read("c", "a", 2) is None  # v2 provably landed...
+    v = o.note_read("c", "a", 1)  # ...so v1 is now stale
+    assert v is not None and v.kind == "stale_read"
+
+
+def test_lost_ack_delete_absent_is_clean_and_settles():
+    o = ConsistencyOracle()
+    o.note_mutation("c", "a", 1, acked=True)
+    o.note_mutation("c", "a", 2, acked=False, delete=True)
+    assert o.note_read("c", "a", None) is None  # delete landed
+    # the collapse is sticky: data reappearing now is a violation
+    v = o.note_read("c", "a", 1)
+    assert v is not None and v.kind == "resurrected_delete"
+
+
+def test_expected_present_audit_helper():
+    o = ConsistencyOracle()
+    # never touched: nothing was ever written, so it must be absent
+    assert o.expected_present("never-touched") is False
+    o.note_mutation("c", "a", 1, acked=True)
+    assert o.expected_present("a") is True
+    o.note_mutation("c", "a", 2, acked=True, delete=True)
+    assert o.expected_present("a") is False
+    o.note_mutation("c", "a", 3, acked=False)
+    assert o.expected_present("a") is None  # indeterminate
+
+
+def test_violations_bump_thrash_counter():
+    perf = build_thrash_perf()
+    o = ConsistencyOracle(perf=perf)
+    o.note_mutation("c", "a", 1, acked=True)
+    o.note_read("c", "a", None)
+    o.add_violation("no_health_convergence", {"timeout": 1})
+    assert perf.dump()["l_thrash_violations"] == 2
+
+
+# -- schedule determinism ---------------------------------------------------
+def test_schedule_same_seed_byte_identical():
+    a = Schedule.from_seed(20260807, duration=45.0, osds=5)
+    b = Schedule.from_seed(20260807, duration=45.0, osds=5)
+    assert a.to_json() == b.to_json()
+    assert a.to_json().encode() == b.to_json().encode()
+
+
+def test_schedule_different_seed_differs():
+    a = Schedule.from_seed(1, duration=45.0, osds=3)
+    b = Schedule.from_seed(2, duration=45.0, osds=3)
+    assert a.to_json() != b.to_json()
+
+
+def test_schedule_roundtrip_and_pairing():
+    s = Schedule.from_seed(99, duration=60.0, osds=4)
+    assert Schedule.from_json(s.to_json()).to_json() == s.to_json()
+    assert s.events == sorted(s.events, key=lambda e: e.t)
+    assert all(e.t <= s.duration for e in s.events)
+    counts = {}
+    for e in s.events:
+        counts[e.kind] = counts.get(e.kind, 0) + 1
+    # paired kinds close as often as they open (epilogue safety)
+    assert counts.get("kill", 0) == counts.get("revive", 0)
+    assert counts.get("netsplit", 0) == counts.get(
+        "heal_netsplit", 0
+    )
+    assert counts.get("out", 0) == counts.get("in", 0)
+    # every targeted event names an existing osd
+    for e in s.events:
+        if "osd" in e.args:
+            assert 0 <= e.args["osd"] < s.osds
+
+
+def test_schedule_rejects_unknown_kinds():
+    with pytest.raises(ValueError, match="frobnicate"):
+        Schedule.from_seed(1, weights={"frobnicate": 3.0})
+
+
+def test_thrasher_rejects_unknown_mutation():
+    from ceph_tpu.qa.thrasher import Thrasher
+
+    with pytest.raises(ValueError, match="bogus"):
+        Thrasher(Schedule.from_seed(1), mutation="bogus")
+
+
+# -- shrinker on a synthetic run function -----------------------------------
+def _ev(i: int) -> ScheduleEvent:
+    return ScheduleEvent(t=float(i), kind="settle", args={"i": i})
+
+
+def test_shrink_finds_minimal_pair():
+    events = [_ev(i) for i in range(12)]
+
+    def reproduces(subset) -> bool:
+        got = {e.args["i"] for e in subset}
+        return {3, 7} <= got
+
+    minimal, runs = shrink_events(events, reproduces)
+    assert {e.args["i"] for e in minimal} == {3, 7}
+    assert runs > 0
+
+
+def test_shrink_counts_probes_on_perf():
+    perf = build_thrash_perf()
+    events = [_ev(i) for i in range(8)]
+    _minimal, runs = shrink_events(
+        events, lambda s: any(e.args["i"] == 5 for e in s),
+        perf=perf,
+    )
+    assert perf.dump()["l_thrash_shrink_steps"] == runs
+
+
+def test_shrink_respects_max_runs():
+    events = [_ev(i) for i in range(64)]
+    _minimal, runs = shrink_events(
+        events, lambda s: len(s) >= 1, max_runs=7
+    )
+    assert runs <= 7
+
+
+def test_shrink_unreproducible_returns_unshrunk():
+    events = [_ev(i) for i in range(6)]
+    minimal, _runs = shrink_events(events, lambda s: False)
+    assert minimal == events
+
+
+# -- repro artifact ---------------------------------------------------------
+def test_write_repro_roundtrip(tmp_path):
+    s = Schedule.from_seed(5, duration=10.0, osds=3)
+    minimal = s.events[:2]
+    vio = [
+        {
+            "kind": "lost_acked_write", "oid": "qa-c0-o0",
+            "client": "audit", "detail": {}, "t": 1.0,
+        }
+    ]
+    path = write_repro(
+        tmp_path, s, minimal, vio, shrink_runs=4,
+        mutation="suppress_replay",
+    )
+    assert path.name == "repro_5.json"
+    doc = load_repro(path)
+    assert doc["mutation"] == "suppress_replay"
+    assert doc["schedule"] == s.to_dict()
+    assert doc["minimal_schedule"]["events"] == [
+        e.to_dict() for e in minimal
+    ]
+    assert doc["report"]["role"] == "qa.thrasher"
+    assert "lost_acked_write" in doc["report"]["reason"]
+    assert doc["report"]["meta"]["shrink_runs"] == 4
+    # canonical bytes: rewriting the same content is a no-op
+    before = path.read_bytes()
+    write_repro(
+        tmp_path, s, minimal, vio, shrink_runs=4,
+        mutation="suppress_replay",
+    )
+    assert path.read_bytes() == before
+    json.loads(before)  # well-formed
+
+
+# -- satellite: injected RNG on the fault plane -----------------------------
+def test_fault_injector_accepts_injected_rng():
+    from random import Random
+
+    from ceph_tpu.msg.faults import FaultInjector
+
+    def stream(rng):
+        f = FaultInjector("osd.1", rng=rng)
+        f.add_rule(dst="*", drop=0.5)
+
+        class _Conn:
+            peer_label = "x"
+
+        return [f.plan(_Conn()).drop for _ in range(32)]
+
+    a = stream(Random(1234))
+    b = stream(Random(1234))
+    c = stream(Random(9999))
+    assert a == b
+    assert a != c
+
+
+# -- satellite: objecter counter schema -------------------------------------
+def test_objecter_backoff_parks_is_a_real_counter():
+    from ceph_tpu.osdc.objecter import build_objecter_perf
+
+    pc = build_objecter_perf()
+    assert "l_objecter_backoff_parks" in pc._counters
+    pc.inc("l_objecter_backoff_parks")
+    assert pc.dump()["l_objecter_backoff_parks"] == 1
+
+
+def test_objecter_compat_property_reads_counter():
+    from ceph_tpu.mon.monitor import MonClient
+    from ceph_tpu.msg import Messenger
+    from ceph_tpu.osdc.objecter import Objecter
+
+    m = Messenger("qa-objecter-compat")
+    try:
+        obj = Objecter(MonClient(m, whoami=-1), m)
+        assert obj.backoff_parks == 0
+        obj.perf.inc("l_objecter_backoff_parks")
+        assert obj.backoff_parks == 1
+        with pytest.raises(AttributeError):
+            obj.backoff_parks = 5  # the int attribute is gone
+    finally:
+        m.shutdown()
+
+
+# -- satellite: fault-plane janitor between tests ---------------------------
+def test_messenger_live_registry_and_sweep():
+    from ceph_tpu.msg.messenger import Messenger
+
+    m = Messenger("qa-janitor")
+    try:
+        assert m in Messenger._live
+        m.faults.add_rule(dst="*", drop=1.0)
+        m.faults.set_partition("split", [["a"], ["b"]])
+        m.inject_socket_failures = 3
+        assert m.faults.active
+        # the conftest sweep's exact actions
+        for live in list(Messenger._live):
+            if live.faults.active:
+                live.faults.clear()
+            live.faults.socket_failure_every = 0
+        assert not m.faults.active
+        assert m.inject_socket_failures == 0
+    finally:
+        m.shutdown()

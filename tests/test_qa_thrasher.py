@@ -1,0 +1,156 @@
+"""Live thrasher gates (tests/test_qa_oracle.py holds the pure-unit
+half).
+
+Tier-1, gating every PR:
+
+- a fixed-seed 30-second smoke thrash against a 3-OSD in-process
+  cluster — zero oracle violations, HEALTH_OK convergence, and the
+  executed schedule byte-identical to the generator's output;
+- the mutation-testing gate: a deliberately broken invariant
+  (suppressed WAL replay) MUST produce a violation, shrinking must
+  cut the schedule to <=25% of its events, and the emitted
+  ``repro_<seed>.json`` must reproduce the violation standalone.
+
+``slow``-marked (the qa/standalone tier): three distinct seeds at
+>=60s each, and a multi-process supervised run where cores allow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from ceph_tpu.qa import Schedule
+from ceph_tpu.qa.thrasher import Thrasher, replay_repro
+
+SMOKE_SEED = 20260807
+
+# the deliberately-broken-run generator knobs: few kinds, power_loss
+# heavy, so the minimal repro is 1-2 events and probes stay cheap
+MUTATION_WEIGHTS = {
+    "power_loss": 3.0,
+    "lossy": 2.0,
+    "settle": 1.0,
+    "kill": 1.0,
+}
+
+
+def test_smoke_thrash_fixed_seed():
+    """The PR gate: 30 scheduled seconds of randomized composed
+    faults against a live 3-OSD cluster, zero violations, HEALTH_OK
+    at the end, real events actually executed."""
+    sched = Schedule.from_seed(SMOKE_SEED, duration=30.0, osds=3)
+    # determinism first: the schedule the run will execute is the
+    # byte-identical artifact a repro would carry
+    again = Schedule.from_seed(SMOKE_SEED, duration=30.0, osds=3)
+    assert sched.to_json() == again.to_json()
+
+    thr = Thrasher(sched, convergence_timeout=60.0)
+    report = thr.run()
+    assert report["violations"] == [], (
+        "oracle violations under the smoke schedule:\n"
+        + json.dumps(report["violations"], indent=2)
+    )
+    assert report["converged"], "never reached HEALTH_OK"
+    assert report["events_applied"] >= len(sched.events) // 2, (
+        f"guards skipped too much: {report['trace']}"
+    )
+    assert report["ops"] > 50, "workload barely ran"
+    assert report["audited"] > 0
+    perf = thr.perf.dump()
+    assert perf["l_thrash_events"] == report["events_applied"]
+    assert perf["l_thrash_violations"] == 0
+
+
+def test_mutation_gate_oracle_fires_and_shrinks(tmp_path):
+    """An oracle nobody has seen fail is an oracle nobody can trust:
+    suppress WAL replay on every remount and the durability invariant
+    MUST break, shrink to <=25% of the schedule, and replay from the
+    emitted artifact."""
+    sched = Schedule.from_seed(
+        777, duration=8.0, osds=3, weights=MUTATION_WEIGHTS
+    )
+    assert any(e.kind == "power_loss" for e in sched.events), (
+        "mutation schedule must include a power_loss (reseed needed)"
+    )
+    thr = Thrasher(
+        sched,
+        mutation="suppress_replay",
+        time_scale=2.0,
+        convergence_timeout=20.0,
+    )
+    report = thr.run_with_shrink(
+        artifact_dir=tmp_path, max_shrink_runs=16
+    )
+    kinds = {v["kind"] for v in report["violations"]}
+    assert "lost_acked_write" in kinds, (
+        f"mutation never tripped the oracle: {report['violations']}"
+    )
+    assert len(report["minimal_events"]) <= max(
+        1, len(sched.events) // 4
+    ), (
+        f"shrink too weak: {len(report['minimal_events'])} of "
+        f"{len(sched.events)} events"
+    )
+    assert thr.perf.dump()["l_thrash_shrink_steps"] == report[
+        "shrink_runs"
+    ]
+
+    # the artifact alone must reproduce the violation
+    path = report["repro_path"]
+    doc = json.loads(open(path).read())
+    assert doc["mutation"] == "suppress_replay"
+    assert doc["report"]["role"] == "qa.thrasher"
+    replay = replay_repro(path, time_scale=2.0)
+    assert any(
+        v["kind"] == "lost_acked_write"
+        for v in replay["violations"]
+    ), "repro artifact did not reproduce the violation"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [11, 20260807, 987654321])
+def test_long_thrash_three_seeds(seed):
+    """Acceptance tier: >=60 scheduled seconds per seed, zero
+    violations, convergence — three distinct weather systems."""
+    sched = Schedule.from_seed(seed, duration=60.0, osds=3)
+    thr = Thrasher(sched, convergence_timeout=90.0)
+    report = thr.run()
+    assert report["violations"] == [], json.dumps(
+        report["violations"], indent=2
+    )
+    assert report["converged"]
+    assert report["events_applied"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    (os.cpu_count() or 1) < 4,
+    reason="multi-process thrash needs cores for the daemon fleet",
+)
+def test_proc_thrash_supervised_fleet(tmp_path):
+    """The multi-process tier: real SIGKILLs via the supervisor's
+    kill-on-request hold API, respawn-driven revivals, `tell`-driven
+    network faults."""
+    sched = Schedule.from_seed(
+        424242, duration=45.0, osds=3,
+        weights={
+            "kill": 3.0, "wal_kill": 2.0, "out": 1.5,
+            "lossy": 2.0, "scrub": 1.0, "settle": 2.0,
+        },
+        pace=2.0,  # proc kills cost seconds; calmer cadence
+    )
+    thr = Thrasher(
+        sched,
+        mode="proc",
+        convergence_timeout=120.0,
+        workdir=str(tmp_path),
+    )
+    report = thr.run()
+    assert report["violations"] == [], json.dumps(
+        report["violations"], indent=2
+    )
+    assert report["converged"]
+    assert report["events_applied"] > 0

@@ -172,6 +172,19 @@ PROC_COUNTERS = (
     "l_proc_restarts",
     "l_proc_crash_loops",
 )
+# qa thrasher counters (qa/thrasher.py build_thrash_perf): the chaos
+# smoke gate's event/violation/shrink accounting
+THRASH_COUNTERS = (
+    "l_thrash_events",
+    "l_thrash_skipped_events",
+    "l_thrash_violations",
+    "l_thrash_shrink_steps",
+)
+# client op-path counters (osdc/objecter.py build_objecter_perf):
+# the backoff-park visibility the full-OSD scenarios read
+OBJECTER_COUNTERS = (
+    "l_objecter_backoff_parks",
+)
 DISPATCH_QUEUE_COUNTERS = (
     "l_msgr_dispatch_queue_depth",
     "l_msgr_dispatch_queue_stalls",
@@ -480,6 +493,34 @@ def check_recovery_counters() -> list[str]:
     return [
         f"osd schema: recovery counter {name!r} missing"
         for name in RECOVERY_COUNTERS
+        if name not in declared
+    ]
+
+
+def check_thrash_counters() -> list[str]:
+    """The qa plane: build_thrash_perf must keep declaring the
+    l_thrash_* family the smoke-thrash gate and repro reports
+    count into."""
+    from ceph_tpu.qa.thrasher import build_thrash_perf
+
+    declared = set(build_thrash_perf()._counters)
+    return [
+        f"qa schema: counter {name!r} missing"
+        for name in THRASH_COUNTERS
+        if name not in declared
+    ]
+
+
+def check_objecter_counters() -> list[str]:
+    """The client op path: build_objecter_perf must keep declaring
+    the l_objecter_* family (backoff parks — the no-resend-storm
+    witness the full-cluster scenarios assert on)."""
+    from ceph_tpu.osdc.objecter import build_objecter_perf
+
+    declared = set(build_objecter_perf()._counters)
+    return [
+        f"objecter schema: counter {name!r} missing"
+        for name in OBJECTER_COUNTERS
         if name not in declared
     ]
 
@@ -931,7 +972,9 @@ def product_counter_sets():
     from ceph_tpu.ops.kernel_stats import KernelStats
     from ceph_tpu.osd.daemon import build_osd_perf
     from ceph_tpu.osd.mapping import _build_perf as build_mapping_perf
+    from ceph_tpu.osdc.objecter import build_objecter_perf
     from ceph_tpu.proc.supervisor import build_proc_perf
+    from ceph_tpu.qa.thrasher import build_thrash_perf
     from ceph_tpu.rgw.index import build_rgw_perf
     from ceph_tpu.store.wal_store import build_wal_perf
 
@@ -957,6 +1000,8 @@ def product_counter_sets():
         build_rgw_perf("rgw"),
         build_wal_perf(),
         build_proc_perf(),
+        build_thrash_perf(),
+        build_objecter_perf(),
     ]
 
 
@@ -987,6 +1032,8 @@ def check_all(sets=None) -> list[str]:
         errors.extend(check_residency_counters())
         errors.extend(check_dispatch_counters())
         errors.extend(check_proc_counters())
+        errors.extend(check_thrash_counters())
+        errors.extend(check_objecter_counters())
         errors.extend(check_recovery_counters())
         errors.extend(check_rgw_counters())
         errors.extend(check_wal_counters())
